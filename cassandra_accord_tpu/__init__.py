@@ -1,0 +1,32 @@
+"""cassandra_accord_tpu — a TPU-native framework implementing the Accord consensus
+protocol (leaderless, shard-per-key-range, strict-serializable multi-key/multi-range
+ACID transactions; 1-RTT fast path, 2-RTT slow path).
+
+Capability reference: bdeggleston/cassandra-accord (Java).  This is NOT a port: the
+consensus/messaging control plane is a clean host-side implementation, and the
+dependency-graph data plane (conflict indexes of in-flight transactions, the
+PreAccept/Accept dependency computation, the execute-phase topological wait) is
+device-resident JAX/XLA/Pallas behind a pluggable ``DepsResolver`` boundary.
+
+Layout (mirrors the reference's layer map, SURVEY.md §1):
+
+- ``utils``       zero-dependency substrate: sorted-array algebra, CSR multimaps,
+                  interval maps, async chains, deterministic RNG, invariants
+- ``primitives``  Timestamp/TxnId/Ballot, Keys/Ranges/Routes, Deps, Txn, Writes
+- ``api``         the SPI the embedding system implements (Agent, DataStore,
+                  MessageSink, ConfigurationService, ProgressLog, Scheduler, ...)
+- ``topology``    epoch-versioned shard maps, fast-path electorates, quorum math
+- ``local``       per-node per-shard replica state machine (Node, CommandStore,
+                  Command lifecycle, CommandsForKey conflict index)
+- ``messages``    wire-protocol request/reply types with replica-side handlers
+- ``coordinate``  coordinator-side phase state machines + quorum trackers
+- ``impl``        in-memory reference implementations of the SPI
+- ``ops``         the TPU data plane: batched deps kernels (overlap join,
+                  transitive closure, topo frontier) + DepsResolver impls
+- ``parallel``    mesh/sharding utilities for multi-chip deps-graph state
+- ``models``      flagship batched deps-graph engine (the jittable "model")
+- ``harness``     deterministic simulation cluster + fault injection + verifiers
+- ``maelstrom``   JSON-over-stdio node adapter for the Maelstrom workbench
+"""
+
+__version__ = "0.1.0"

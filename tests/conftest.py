@@ -1,0 +1,17 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU platform so
+multi-chip sharding tests run without TPU hardware (the driver separately
+dry-run-compiles the multi-chip path via __graft_entry__.dryrun_multichip)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    from cassandra_accord_tpu.utils.random import RandomSource
+    return RandomSource(12345)

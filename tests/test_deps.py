@@ -1,0 +1,144 @@
+"""KeyDeps/RangeDeps/Deps CSR multimap semantics.
+
+Parity targets: KeyDepsTest/RangeDepsTest/DepsTest
+(accord-core/src/test/java/accord/primitives/KeyDepsTest.java:1-619) — build, merge,
+slice, invert, without — checked against dict/set oracles.
+"""
+from collections import defaultdict
+
+from cassandra_accord_tpu.primitives.deps import (
+    Deps, DepsBuilder, KeyDeps, KeyDepsBuilder, RangeDeps, RangeDepsBuilder,
+)
+from cassandra_accord_tpu.primitives.keys import IntKey, Range, Ranges
+from cassandra_accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v):
+    return IntKey(v)
+
+
+def r(a, b):
+    return Range(k(a), k(b))
+
+
+def tid(hlc, node=1, kind=TxnKind.WRITE, domain=Domain.KEY):
+    return TxnId(1, hlc, node, kind, domain)
+
+
+def build_random(rng, nkeys=10, ntxn=20):
+    oracle = defaultdict(set)
+    b = KeyDepsBuilder()
+    for _ in range(rng.next_int(1, 60)):
+        key = k(rng.next_int(nkeys))
+        t = tid(rng.next_int(ntxn), rng.next_int(1, 4))
+        b.add(key, t)
+        oracle[key].add(t)
+    return b.build(), oracle
+
+
+def as_dict(kd: KeyDeps):
+    out = {}
+    kd.for_each_key(lambda key, tids: out.__setitem__(key, set(tids)))
+    return out
+
+
+def test_keydeps_build_and_access():
+    a, b, c = tid(1), tid(2), tid(3)
+    kd = KeyDeps.of({k(1): [a, b], k(2): [b, c]})
+    assert kd.txn_id_count() == 3
+    assert kd.txn_ids_for(k(1)) == [a, b]
+    assert kd.txn_ids_for(k(2)) == [b, c]
+    assert kd.txn_ids_for(k(9)) == []
+    assert kd.contains(b) and not kd.contains(tid(99))
+    assert kd.max_txn_id() == c
+
+
+def test_keydeps_invert_participants():
+    a, b = tid(1), tid(2)
+    kd = KeyDeps.of({k(1): [a], k(2): [a, b], k(3): [b]})
+    assert [x.value for x in kd.participants(a)] == [1, 2]
+    assert [x.value for x in kd.participants(b)] == [2, 3]
+    assert list(kd.participants(tid(77))) == []
+
+
+def test_keydeps_merge_equals_oracle_union():
+    rng = RandomSource(42)
+    for _ in range(30):
+        kd1, o1 = build_random(rng)
+        kd2, o2 = build_random(rng)
+        merged = KeyDeps.merge([kd1, kd2])
+        oracle = defaultdict(set)
+        for o in (o1, o2):
+            for key, s in o.items():
+                oracle[key] |= s
+        assert as_dict(merged) == {key: s for key, s in oracle.items() if s}
+
+
+def test_keydeps_slice_without():
+    rng = RandomSource(43)
+    for _ in range(30):
+        kd, oracle = build_random(rng)
+        lo, hi = rng.next_int(0, 5), rng.next_int(5, 11)
+        sliced = kd.slice(Ranges.of(r(lo, hi)))
+        expect = {key: s for key, s in oracle.items() if lo <= key.value < hi}
+        assert as_dict(sliced) == expect
+        # txn ids not referenced by any kept key must be dropped
+        refd = set().union(*expect.values()) if expect else set()
+        assert set(sliced.txn_ids) == refd
+
+        cutoff = tid(10)
+        filtered = kd.without(lambda t: t < cutoff)
+        expect2 = {key: {t for t in s if not t < cutoff} for key, s in oracle.items()}
+        expect2 = {key: s for key, s in expect2.items() if s}
+        assert as_dict(filtered) == expect2
+
+
+def test_rangedeps_stabbing_and_slice():
+    a, b, c = tid(1), tid(2), tid(3, domain=Domain.RANGE)
+    rd = RangeDeps.of({r(0, 10): [a], r(5, 15): [b, c], r(20, 30): [c]})
+    assert rd.intersecting_txn_ids(k(7)) == sorted([a, b, c])
+    assert rd.intersecting_txn_ids(k(12)) == sorted([b, c])
+    assert rd.intersecting_txn_ids(k(25)) == [c]
+    assert rd.intersecting_txn_ids(k(16)) == []
+    assert rd.intersecting_txn_ids(r(8, 21)) == sorted([a, b, c])
+    sliced = rd.slice(Ranges.of(r(0, 6)))
+    assert sliced.intersecting_txn_ids(k(5)) == sorted([a, b, c])
+    assert sliced.intersecting_txn_ids(k(7)) == []
+
+
+def test_rangedeps_participants_without_merge():
+    a, b = tid(1), tid(2)
+    rd = RangeDeps.of({r(0, 10): [a], r(20, 30): [a, b]})
+    assert list(rd.participants(a)) == [r(0, 10), r(20, 30)]
+    assert list(rd.participants(b)) == [r(20, 30)]
+    rd2 = rd.without(lambda t: t == a)
+    assert rd2.intersecting_txn_ids(r(0, 100)) == [b]
+    m = RangeDeps.merge([rd, RangeDeps.of({r(40, 50): [b]})])
+    assert m.intersecting_txn_ids(r(0, 100)) == [a, b]
+
+
+def test_deps_builder_routing():
+    """DepsBuilder routes adds by domain + managesExecution (Deps.java:80-106)."""
+    w = tid(1)                                    # key write -> key_deps
+    sp = tid(2, kind=TxnKind.SYNC_POINT)          # key sync point -> direct_key_deps
+    rw = tid(3, domain=Domain.RANGE)              # range txn -> range_deps
+    b = DepsBuilder()
+    b.add(k(1), w)
+    b.add(k(1), sp)
+    b.add(r(0, 5), rw)
+    d = b.build()
+    assert d.key_deps.contains(w) and not d.key_deps.contains(sp)
+    assert d.direct_key_deps.contains(sp)
+    assert d.range_deps.contains(rw)
+    assert set(d.txn_ids()) == {w, sp, rw}
+    assert d.contains(w) and d.contains(sp) and d.contains(rw)
+
+
+def test_deps_merge_slice():
+    d1 = DepsBuilder().add(k(1), tid(1)).build()
+    d2 = DepsBuilder().add(k(2), tid(2)).build()
+    m = Deps.merge([d1, d2])
+    assert m.txn_id_count() == 2
+    s = m.slice(Ranges.of(r(0, 2)))
+    assert s.txn_ids() == [tid(1)]

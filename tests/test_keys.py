@@ -1,0 +1,89 @@
+"""Keys/Ranges sorted-set algebra.
+
+Parity targets: AbstractKeys/AbstractRanges/Range semantics
+(AbstractRanges.java:1-788, Range.java:1-451) exercised property-style against
+set-based oracles.
+"""
+from cassandra_accord_tpu.primitives.keys import (
+    IntKey, Keys, Range, Ranges, RoutingKeys, SentinelKey,
+)
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v, p=0):
+    return IntKey(v, p)
+
+
+def r(a, b, p=0):
+    return Range(k(a, p), k(b, p))
+
+
+def test_keys_basic():
+    ks = Keys.of([k(3), k(1), k(2), k(1)])
+    assert len(ks) == 3
+    assert [key.value for key in ks] == [1, 2, 3]
+    assert ks.contains(k(2)) and not ks.contains(k(4))
+    assert ks.index_of(k(2)) == 1
+    assert ks.index_of(k(4)) == -4  # insertion point 3 -> -3-1
+
+
+def test_keys_union_intersect():
+    a = Keys.of([k(1), k(3), k(5)])
+    b = Keys.of([k(2), k(3), k(6)])
+    assert [x.value for x in a.union(b)] == [1, 2, 3, 5, 6]
+    assert a.intersects(b)
+    assert not Keys.of([k(1)]).intersects(Keys.of([k(2)]))
+
+
+def test_keys_slice_by_ranges():
+    ks = Keys.of([k(i) for i in range(10)])
+    sliced = ks.slice(Ranges.of(r(2, 5), r(7, 9)))
+    assert [x.value for x in sliced] == [2, 3, 4, 7, 8]  # half-open
+
+
+def test_range_ops():
+    a, b = r(0, 10), r(5, 15)
+    assert a.intersects(b)
+    assert a.intersection(b) == r(5, 10)
+    assert not r(0, 5).intersects(r(5, 10))  # half-open adjacency
+    assert a.contains(k(0)) and a.contains(k(9)) and not a.contains(k(10))
+    assert r(0, 20).contains_range(b)
+
+
+def test_ranges_normalize_coalesce():
+    rs = Ranges.of(r(5, 10), r(0, 6), r(12, 15))
+    assert list(rs) == [r(0, 10), r(12, 15)]
+    assert rs.contains(k(9)) and not rs.contains(k(11))
+
+
+def test_ranges_algebra():
+    a = Ranges.of(r(0, 10), r(20, 30))
+    b = Ranges.of(r(5, 25))
+    assert list(a.intersection(b)) == [r(5, 10), r(20, 25)]
+    assert list(a.union(b)) == [r(0, 30)]
+    assert list(a.without(b)) == [r(0, 5), r(25, 30)]
+    assert a.intersects(b)
+    assert a.contains_all(Ranges.of(r(2, 8)))
+    assert not a.contains_all(Ranges.of(r(8, 12)))
+
+
+def test_prefix_sentinels():
+    full0 = Range.full_prefix(0)
+    full1 = Range.full_prefix(1)
+    assert full0.contains(k(999999, 0)) and not full0.contains(k(0, 1))
+    assert not full0.intersects(full1)
+    assert SentinelKey.min(0) < k(-10**9, 0) < k(10**9, 0) < SentinelKey.max(0) < SentinelKey.min(1)
+
+
+def test_random_against_set_oracle():
+    rng = RandomSource(7)
+    for _ in range(50):
+        xs = {rng.next_int(100) for _ in range(rng.next_int(1, 30))}
+        ys = {rng.next_int(100) for _ in range(rng.next_int(1, 30))}
+        a, b = Keys.of(map(k, xs)), Keys.of(map(k, ys))
+        assert {x.value for x in a.union(b)} == xs | ys
+        lo = rng.next_int(0, 50)
+        hi = rng.next_int(lo + 1, 101)
+        sliced = a.slice(Ranges.of(r(lo, hi)))
+        assert {x.value for x in sliced} == {v for v in xs if lo <= v < hi}
+        assert a.intersects(b) == bool(xs & ys)

@@ -1,0 +1,103 @@
+"""Timestamp/TxnId/Ballot ordering, flags, packing and the witness matrix.
+
+Parity targets: reference TxnIdTest / Timestamp semantics
+(accord-core/src/test/java/accord/primitives/TxnIdTest.java, Timestamp.java:27-118).
+"""
+import pytest
+
+from cassandra_accord_tpu.primitives.timestamp import (
+    Ballot, Domain, REJECTED_FLAG, Timestamp, TxnId, TxnKind,
+)
+
+
+def test_total_order():
+    a = Timestamp(1, 10, 1)
+    b = Timestamp(1, 10, 2)
+    c = Timestamp(1, 11, 1)
+    d = Timestamp(2, 0, 0)
+    assert a < b < c < d
+    assert sorted([d, c, b, a]) == [a, b, c, d]
+    assert a == Timestamp(1, 10, 1)
+    assert hash(a) == hash(Timestamp(1, 10, 1))
+
+
+def test_epoch_bounds():
+    lo = Timestamp.min_for_epoch(5)
+    hi = Timestamp.max_for_epoch(5)
+    mid = Timestamp(5, 123, 7)
+    assert lo <= mid <= hi
+    assert hi < Timestamp.min_for_epoch(6)
+
+
+def test_merge_max_retains_rejected_flag():
+    a = Timestamp(1, 10, 1).with_rejected()
+    b = Timestamp(1, 20, 1)
+    m = a.merge_max(b)
+    assert m.epoch == 1 and m.hlc == 20
+    assert m.is_rejected  # MERGE_FLAGS retained from the smaller operand
+    m2 = b.merge_max(a)
+    assert m2.is_rejected
+
+
+def test_pack_unpack_roundtrip():
+    t = Timestamp(123456, (1 << 50) + 17, 42, 0x1E)
+    msb, lsb = t.pack64()
+    assert Timestamp.unpack64(msb, lsb, 42) == t
+    # packed ordering agrees with logical ordering
+    u = Timestamp(123456, (1 << 50) + 18, 42)
+    assert t.pack64() < u.pack64()
+
+
+def test_txnid_kind_domain_roundtrip():
+    for kind in TxnKind:
+        for domain in Domain:
+            t = TxnId(3, 99, 5, kind, domain)
+            assert t.kind is kind
+            assert t.domain is domain
+            assert t.epoch == 3 and t.hlc == 99 and t.node == 5
+
+
+def test_txnid_ordering_consistent_with_timestamp():
+    t1 = TxnId(1, 5, 1, TxnKind.READ)
+    t2 = TxnId(1, 5, 1, TxnKind.WRITE)
+    # different kinds differ in flags => not equal, but both between neighbors
+    assert t1 != t2
+    lo, hi = Timestamp(1, 4, 9), Timestamp(1, 6, 0)
+    assert lo < t1 < hi and lo < t2 < hi
+
+
+def test_witness_matrix():
+    R, W, E = TxnKind.READ, TxnKind.WRITE, TxnKind.EPHEMERAL_READ
+    S, X, L = TxnKind.SYNC_POINT, TxnKind.EXCLUSIVE_SYNC_POINT, TxnKind.LOCAL_ONLY
+    # Read/EphemeralRead witness only writes (Txn.java: Ws)
+    for r in (R, E):
+        assert r.witnesses(W)
+        assert not r.witnesses(R) and not r.witnesses(E)
+        assert not r.witnesses(S) and not r.witnesses(X)
+    # Write/SyncPoint witness reads+writes (RsOrWs) — not ephemeral reads
+    for w in (W, S):
+        assert w.witnesses(R) and w.witnesses(W)
+        assert not w.witnesses(E) and not w.witnesses(X)
+    # ExclusiveSyncPoint witnesses any globally visible
+    assert X.witnesses(R) and X.witnesses(W) and X.witnesses(S) and X.witnesses(X)
+    assert not X.witnesses(E) and not X.witnesses(L)
+    # witnessed_by is the inverse of witnesses for globally-visible pairs
+    for a in TxnKind:
+        for b in TxnKind:
+            if a.is_globally_visible and b.is_globally_visible:
+                assert a.witnessed_by(b) == b.witnesses(a), (a, b)
+    # EphemeralRead is witnessed by nothing
+    for k in TxnKind:
+        assert not E.witnessed_by(k)
+
+
+def test_ballot():
+    b = Ballot(1, 2, 3)
+    assert Ballot.ZERO < b < Ballot.MAX
+    assert isinstance(b.merge_max(Ballot(1, 5, 0)), Timestamp)
+
+
+def test_awaits_only_deps():
+    assert TxnKind.EXCLUSIVE_SYNC_POINT.awaits_only_deps
+    assert TxnKind.EPHEMERAL_READ.awaits_only_deps
+    assert not TxnKind.WRITE.awaits_only_deps

@@ -1,0 +1,124 @@
+"""Shard quorum math, Topology selection, TopologyManager epoch ledger.
+
+Parity targets: Shard.java:38-90 quorum formulas, TopologyManagerTest (:1-584).
+"""
+import pytest
+
+from cassandra_accord_tpu.primitives.keys import IntKey, Range, Ranges
+from cassandra_accord_tpu.primitives.route import Route
+from cassandra_accord_tpu.primitives.keys import RoutingKeys
+from cassandra_accord_tpu.topology import Shard, Topologies, Topology, TopologyManager
+
+
+def k(v):
+    return IntKey(v)
+
+
+def r(a, b):
+    return Range(k(a), k(b))
+
+
+def test_shard_quorum_math():
+    # formulas from Shard.java:71-90
+    s3 = Shard(r(0, 100), [1, 2, 3])
+    assert s3.max_failures == 1
+    assert s3.slow_path_quorum_size == 2
+    assert s3.fast_path_quorum_size == (1 + 3) // 2 + 1 == 3
+    assert s3.recovery_fast_path_size == 1
+
+    s5 = Shard(r(0, 100), [1, 2, 3, 4, 5])
+    assert s5.max_failures == 2
+    assert s5.slow_path_quorum_size == 3
+    assert s5.fast_path_quorum_size == (2 + 5) // 2 + 1 == 4
+
+    # smaller electorate lowers the fast-path quorum
+    s5e = Shard(r(0, 100), [1, 2, 3, 4, 5], fast_path_electorate=[1, 2, 3])
+    assert s5e.fast_path_quorum_size == (2 + 3) // 2 + 1 == 3
+    # electorate must include at least n-f nodes
+    with pytest.raises(ValueError):
+        Shard(r(0, 100), [1, 2, 3, 4, 5], fast_path_electorate=[1, 2])
+
+
+def test_rejects_fast_path():
+    s = Shard(r(0, 100), [1, 2, 3])  # fp quorum 3 of electorate 3
+    assert not s.rejects_fast_path(0)
+    assert s.rejects_fast_path(1)
+
+
+def test_topology_lookup_and_views():
+    t = Topology(1, [Shard(r(0, 10), [1, 2, 3]), Shard(r(10, 20), [2, 3, 4])])
+    assert t.for_key(k(5)).nodes == (1, 2, 3)
+    assert t.for_key(k(10)).nodes == (2, 3, 4)
+    assert t.for_key(k(25)) is None
+    assert t.nodes() == {1, 2, 3, 4}
+    assert t.ranges_for_node(1) == Ranges.of(r(0, 10))
+    assert t.ranges_for_node(3) == Ranges.of(r(0, 20))
+    sel = t.for_selection(RoutingKeys.of([k(5), k(15)]))
+    assert len(sel) == 2
+    assert t.nodes_for(Ranges.of(r(0, 5))) == [1, 2, 3]
+    route = Route.for_keys(k(5), RoutingKeys.of([k(5)]))
+    assert t.nodes_for(route) == [1, 2, 3]
+
+
+def test_topology_rejects_overlapping_shards():
+    with pytest.raises(ValueError):
+        Topology(1, [Shard(r(0, 10), [1]), Shard(r(5, 15), [2])])
+
+
+def test_topologies_stack():
+    t1 = Topology(1, [Shard(r(0, 10), [1, 2, 3])])
+    t2 = Topology(2, [Shard(r(0, 10), [2, 3, 4])])
+    ts = Topologies([t1, t2])
+    assert ts.current_epoch == 2 and ts.oldest_epoch == 1
+    assert ts.for_epoch(1) is t1 and ts.for_epoch(2) is t2
+    assert ts.nodes() == {1, 2, 3, 4}
+    assert ts.for_epochs(2, 2).size() == 1
+
+
+def test_topology_manager_epochs_and_sync():
+    tm = TopologyManager(node_id=1)
+    t1 = Topology(1, [Shard(r(0, 10), [1, 2, 3])])
+    t2 = Topology(2, [Shard(r(0, 10), [2, 3, 4])])
+    tm.on_topology_update(t1)
+    assert tm.current_epoch == 1
+    assert tm.is_sync_complete(1)  # first epoch trivially synced
+    tm.on_topology_update(t2)
+    assert tm.current_epoch == 2
+    assert not tm.is_sync_complete(2)
+    # sync quorum for epoch 2's single shard {2,3,4} needs 2 acks
+    tm.on_remote_sync_complete(2, 2)
+    assert not tm.is_sync_complete(2)
+    tm.on_remote_sync_complete(3, 2)
+    assert tm.is_sync_complete(2)
+
+    # unsynced extension: while epoch 2 unsynced, coordination at epoch 3 reaches back
+    t3 = Topology(3, [Shard(r(0, 10), [2, 3, 4])])
+    tm.on_topology_update(t3)
+    assert tm.with_unsynced_epochs(None, 3, 3).size() == 1  # 2 is synced now
+    t4 = Topology(4, [Shard(r(0, 10), [2, 3, 4])])
+    tm.on_topology_update(t4)
+    assert tm.with_unsynced_epochs(None, 4, 4).size() == 2  # 3 not synced -> include
+
+
+def test_topology_manager_await_and_pending_sync():
+    tm = TopologyManager(node_id=1)
+    fut = tm.await_epoch(1)
+    assert not fut.is_done()
+    # sync report arriving before the topology is buffered
+    tm.on_remote_sync_complete(2, 2)
+    t1 = Topology(1, [Shard(r(0, 10), [1, 2, 3])])
+    tm.on_topology_update(t1)
+    assert fut.is_done()
+    t2 = Topology(2, [Shard(r(0, 10), [1, 2, 3])])
+    tm.on_topology_update(t2)
+    tm.on_remote_sync_complete(3, 2)
+    assert tm.is_sync_complete(2)
+
+
+def test_topology_manager_truncate():
+    tm = TopologyManager(node_id=1)
+    for e in range(1, 5):
+        tm.on_topology_update(Topology(e, [Shard(r(0, 10), [1, 2, 3])]))
+    tm.truncate_until(3)
+    assert tm.min_epoch == 3
+    assert tm.has_epoch(3) and tm.has_epoch(4) and not tm.has_epoch(2)

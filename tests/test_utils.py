@@ -1,0 +1,153 @@
+"""Async chains, interval maps, RNG determinism, invariants.
+
+Parity targets: AsyncChainsTest (:1-365), ReducingRangeMapTest, RandomTest.
+"""
+import pytest
+
+from cassandra_accord_tpu.utils import async_ as au
+from cassandra_accord_tpu.utils.interval_map import ReducingIntervalMap
+from cassandra_accord_tpu.utils.invariants import InvariantViolation, Invariants, Paranoia
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+# -- async ------------------------------------------------------------------
+
+def test_chain_map_flatmap():
+    out = []
+    au.done(2).map(lambda x: x * 10).flat_map(lambda x: au.done(x + 1)) \
+        .begin(lambda v, f: out.append((v, f)))
+    assert out == [(21, None)]
+
+
+def test_chain_failure_propagates_and_recovers():
+    boom = RuntimeError("boom")
+    out = []
+    au.failure(boom).map(lambda x: x).begin(lambda v, f: out.append(f))
+    assert out == [boom]
+    out2 = []
+    au.failure(boom).recover(lambda e: 99).begin(lambda v, f: out2.append((v, f)))
+    assert out2 == [(99, None)]
+
+
+def test_chain_single_begin():
+    c = au.done(1)
+    c.begin(lambda v, f: None)
+    with pytest.raises(RuntimeError):
+        c.begin(lambda v, f: None)
+
+
+def test_map_raising_fails_chain():
+    out = []
+    au.done(1).map(lambda x: 1 // 0).begin(lambda v, f: out.append(type(f)))
+    assert out == [ZeroDivisionError]
+
+
+def test_settable_result_listeners():
+    s = au.settable()
+    seen = []
+    s.add_listener(lambda v, f: seen.append(v))
+    assert not s.is_done()
+    assert s.set_success(5)
+    assert not s.set_success(6)  # only first completion wins
+    assert seen == [5]
+    # late listener fires immediately
+    s.add_listener(lambda v, f: seen.append(v * 2))
+    assert seen == [5, 10]
+    assert s.value == 5
+
+
+def test_all_of():
+    out = []
+    au.all_of([au.done(1), au.done(2), au.done(3)]).begin(lambda v, f: out.append(v))
+    assert out == [[1, 2, 3]]
+    out2 = []
+    au.all_of([au.done(1), au.failure(ValueError("x"))]).begin(lambda v, f: out2.append(type(f)))
+    assert out2 == [ValueError]
+
+
+def test_begin_result_multi_listener():
+    r = au.done(7).begin_result()
+    assert r.is_success() and r.value == 7
+
+
+# -- interval map -----------------------------------------------------------
+
+def test_interval_map_lookup():
+    m = ReducingIntervalMap.of_range(10, 20, "a")
+    assert m.get(9) is None
+    assert m.get(10) == "a"
+    assert m.get(19) == "a"
+    assert m.get(20) is None
+
+
+def test_interval_map_merge_reduce():
+    a = ReducingIntervalMap.of_range(0, 10, 1)
+    b = ReducingIntervalMap.of_range(5, 15, 2)
+    m = a.merge(b, max)
+    assert m.get(3) == 1
+    assert m.get(7) == 2
+    assert m.get(12) == 2
+    assert m.get(16) is None
+
+
+def test_interval_map_merge_against_oracle():
+    rng = RandomSource(9)
+    for _ in range(60):
+        def rand_map():
+            m = ReducingIntervalMap.constant(None)
+            for _ in range(rng.next_int(1, 5)):
+                lo = rng.next_int(0, 40)
+                hi = rng.next_int(lo + 1, 50)
+                m = m.merge(ReducingIntervalMap.of_range(lo, hi, rng.next_int(1, 100)), max)
+            return m
+        a, b = rand_map(), rand_map()
+        merged = a.merge(b, max)
+        for probe in range(-1, 51):
+            va, vb = a.get(probe), b.get(probe)
+            expect = max((v for v in (va, vb) if v is not None), default=None)
+            assert merged.get(probe) == expect, probe
+
+
+def test_interval_map_of_ranges_adjacent():
+    m = ReducingIntervalMap.of_ranges([(0, 5), (5, 10), (20, 30)], "x")
+    assert m.get(4) == "x" and m.get(5) == "x" and m.get(9) == "x"
+    assert m.get(10) is None and m.get(25) == "x"
+
+
+# -- rng --------------------------------------------------------------------
+
+def test_rng_determinism_and_fork():
+    a, b = RandomSource(1), RandomSource(1)
+    assert [a.next_int(100) for _ in range(20)] == [b.next_int(100) for _ in range(20)]
+    fa, fb = a.fork(), b.fork()
+    assert [fa.next_long() for _ in range(5)] == [fb.next_long() for _ in range(5)]
+
+
+def test_rng_biased_and_zipf():
+    rng = RandomSource(2)
+    for _ in range(100):
+        v = rng.next_biased_int(0, 10, 100)
+        assert 0 <= v < 100
+    counts = [0] * 5
+    for _ in range(500):
+        counts[rng.next_zipf(5)] += 1
+    assert counts[0] > counts[4]  # zipf skew
+
+
+# -- invariants -------------------------------------------------------------
+
+def test_invariants():
+    Invariants.check_state(True)
+    with pytest.raises(InvariantViolation):
+        Invariants.check_state(False, "bad %s", "state")
+    with pytest.raises(ValueError):
+        Invariants.check_argument(False)
+    old = Invariants.paranoia
+    try:
+        Invariants.set_paranoia(Paranoia.NONE)
+        Invariants.paranoid(lambda: False)  # not evaluated at NONE
+        Invariants.set_paranoia(Paranoia.SUPERLINEAR)
+        with pytest.raises(InvariantViolation):
+            Invariants.paranoid(lambda: False)
+    finally:
+        Invariants.set_paranoia(old)
