@@ -1,0 +1,320 @@
+"""The coordinator-side transaction pipeline.
+
+Capability parity with ``accord.coordinate`` CoordinateTransaction / CoordinatePreAccept
+/ Propose / Stabilise / ExecuteTxn / PersistTxn (CoordinateTransaction.java:50-113,
+CoordinatePreAccept.java:51-164, Propose.java:1-234, CoordinationAdapter.java:48-331):
+
+  PreAccept round (FastPathTracker)
+    fast path:  witnessedAt == txnId at a fast-path quorum of every shard
+                -> executeAt = txnId, deps = merge of fast-path-voting replicas' deps
+                -> Execute (Stable+Read fused)
+    slow path:  executeAt = mergeMax(witnessedAt); Propose (Accept round, ballot 0)
+                -> deps at executeAt from AcceptOks -> Stabilise+Execute
+  Execute:      Stable(+Read) to one replica per shard, Stable to the rest;
+                on data from every shard: Writes = txn.execute, Result = txn.result
+  Persist:      reply to client FIRST, then Apply.Minimal to every replica
+                (CoordinationAdapter.java:192-197).
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+from ..messages.base import Callback, FailureReply, TxnRequest
+from ..messages.txn_messages import (
+    Accept, AcceptNack, AcceptOk, Apply, Commit, CommitNack, CommitOk, PreAccept,
+    PreAcceptNack, PreAcceptOk, ReadNack, ReadOk,
+)
+from ..local.status import SaveStatus
+from ..primitives.deps import Deps
+from ..primitives.route import Route
+from ..primitives.timestamp import Ballot, Timestamp, TxnId
+from ..primitives.txn import Txn
+from ..utils import async_ as au
+from .errors import Exhausted, Insufficient, Invalidated, Preempted, Timeout
+from .tracking import FastPathTracker, QuorumTracker, ReadTracker, RequestStatus
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+
+class ExecutePath:
+    FAST = "fast"
+    SLOW = "slow"
+    RECOVER = "recover"
+
+
+def coordinate_transaction(node: "Node", txn_id: TxnId, txn: Txn,
+                           result: au.Settable) -> None:
+    route = node.compute_route(txn)
+    _CoordinateTransaction(node, txn_id, txn, route, result).start()
+
+
+class _CoordinateTransaction:
+    def __init__(self, node: "Node", txn_id: TxnId, txn: Txn, route: Route,
+                 result: au.Settable):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.result = result
+        self.topologies = node.topology.with_unsynced_epochs(route, txn_id.epoch, txn_id.epoch)
+
+    # -- PreAccept round ----------------------------------------------------
+    def start(self) -> None:
+        tracker = FastPathTracker(self.topologies)
+        oks: Dict[int, PreAcceptOk] = {}
+        this = self
+
+        class PreAcceptCallback(Callback):
+            done = False
+
+            def on_success(self, from_node: int, reply) -> None:
+                if self.done:
+                    return
+                if isinstance(reply, PreAcceptNack):
+                    # a competing ballot exists (recovery in progress)
+                    status = tracker.record_failure(from_node)
+                else:
+                    oks[from_node] = reply
+                    status = tracker.record_success(from_node, reply.witnessed_fast_path)
+                if status is RequestStatus.SUCCESS:
+                    self.done = True
+                    this.on_preaccepted(tracker, oks)
+                elif status is RequestStatus.FAILED:
+                    self.done = True
+                    this.result.set_failure(Exhausted(this.txn_id, "preaccept"))
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                if self.done:
+                    return
+                if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                    self.done = True
+                    this.result.set_failure(Exhausted(this.txn_id, "preaccept"))
+
+        callback = PreAcceptCallback()
+        max_epoch = self.topologies.current_epoch
+        self.node.send_to_each(
+            tracker.nodes(),
+            lambda to: self._preaccept_for(to, max_epoch),
+            callback)
+
+    def _preaccept_for(self, to: int, max_epoch: int) -> Optional[PreAccept]:
+        scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+        if scope is None:
+            return None
+        wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+        partial = self.txn.slice(_scope_ranges(self.node, scope, max_epoch), to == self.node.id)
+        return PreAccept(self.txn_id, scope, wait_for, partial, max_epoch)
+
+    def on_preaccepted(self, tracker: FastPathTracker, oks: Dict[int, PreAcceptOk]) -> None:
+        # executeAt = fold mergeMax over witnessed timestamps (CoordinatePreAccept:152-163)
+        execute_at: Optional[Timestamp] = None
+        for ok in oks.values():
+            execute_at = ok.witnessed_at if execute_at is None else execute_at.merge_max(ok.witnessed_at)
+
+        if tracker.has_fast_path_accepted():
+            # merge deps only from replicas that voted fast-path (they witnessed
+            # everything that could execute before us) — CoordinateTransaction:71-77
+            deps = Deps.merge([ok.deps for ok in oks.values() if ok.witnessed_fast_path])
+            self.execute(ExecutePath.FAST, self.txn_id.as_timestamp(), deps)
+        elif execute_at is not None and execute_at.is_rejected:
+            self.result.set_failure(Invalidated(self.txn_id, "preaccept rejected"))
+        else:
+            deps = Deps.merge([ok.deps for ok in oks.values()])
+            self.propose(Ballot.ZERO, execute_at, deps)
+
+    # -- Propose (Accept round, Propose.java) --------------------------------
+    def propose(self, ballot: Ballot, execute_at: Timestamp, deps: Deps) -> None:
+        topologies = self.topologies
+        tracker = QuorumTracker(topologies)
+        accept_oks: List[AcceptOk] = []
+        this = self
+
+        class AcceptCallback(Callback):
+            done = False
+
+            def on_success(self, from_node: int, reply) -> None:
+                if self.done:
+                    return
+                if isinstance(reply, AcceptNack):
+                    self.done = True
+                    this.result.set_failure(Preempted(this.txn_id, f"by {reply.supersceded_by}"))
+                    return
+                accept_oks.append(reply)
+                if tracker.record_success(from_node) is RequestStatus.SUCCESS:
+                    self.done = True
+                    # deps at executeAt = merge of accept-ok deps (Propose.java)
+                    stable_deps = Deps.merge([deps] + [ok.deps for ok in accept_oks])
+                    this.stabilise_and_execute(execute_at, stable_deps)
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                if self.done:
+                    return
+                if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                    self.done = True
+                    this.result.set_failure(Exhausted(this.txn_id, "accept"))
+
+        callback = AcceptCallback()
+        self.node.send_to_each(
+            tracker.nodes(),
+            lambda to: self._accept_for(to, ballot, execute_at, deps),
+            callback)
+
+    def _accept_for(self, to: int, ballot: Ballot, execute_at: Timestamp,
+                    deps: Deps) -> Optional[Accept]:
+        scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+        if scope is None:
+            return None
+        wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+        ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
+        from ..primitives.keys import Ranges as _Ranges
+        keys = self.txn.keys.intersection(ranges) if isinstance(self.txn.keys, _Ranges) \
+            else self.txn.keys.slice(ranges)
+        return Accept(self.txn_id, scope, wait_for, ballot, execute_at,
+                      keys, deps.slice(ranges))
+
+    # -- Stabilise + Execute -------------------------------------------------
+    def execute(self, path: str, execute_at: Timestamp, deps: Deps) -> None:
+        """Fast path: Stable+Read immediately (stability is recoverable from the
+        fast-path quorum)."""
+        _ExecuteTxn(self.node, self.txn_id, self.txn, self.route, self.topologies,
+                    SaveStatus.STABLE, execute_at, deps, self.result,
+                    require_stable_quorum=False).start()
+
+    def stabilise_and_execute(self, execute_at: Timestamp, deps: Deps) -> None:
+        """Slow path: the Stable round must reach a quorum per shard before the
+        outcome is reported, so recovery always finds the stable deps
+        (Stabilise.java)."""
+        _ExecuteTxn(self.node, self.txn_id, self.txn, self.route, self.topologies,
+                    SaveStatus.STABLE, execute_at, deps, self.result,
+                    require_stable_quorum=True).start()
+
+
+class _ExecuteTxn:
+    """Sends Stable(+Read fused) and collects per-shard Data (ExecuteTxn.java:53-200,
+    ReadCoordinator.java)."""
+
+    def __init__(self, node: "Node", txn_id: TxnId, txn: Txn, route: Route,
+                 topologies, kind_status: SaveStatus, execute_at: Timestamp, deps: Deps,
+                 result: au.Settable, require_stable_quorum: bool):
+        self.node = node
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.topologies = topologies
+        self.kind_status = kind_status
+        self.execute_at = execute_at
+        self.deps = deps
+        self.result = result
+        self.require_stable_quorum = require_stable_quorum
+        self.read_tracker = ReadTracker(topologies)
+        self.stable_tracker = QuorumTracker(topologies)
+        self.data = None
+        self.done = False
+
+    def start(self) -> None:
+        read_nodes = set(self.read_tracker.initial_contacts(prefer=self.node.id))
+        this = self
+
+        class ExecuteCallback(Callback):
+            def on_success(self, from_node: int, reply) -> None:
+                if this.done:
+                    return
+                if isinstance(reply, ReadOk):
+                    if reply.data is not None:
+                        this.data = reply.data if this.data is None else this.data.merge(reply.data)
+                    this.on_stable_ack(from_node)
+                    if not this.done and this.read_tracker.record_read_success(from_node) \
+                            is RequestStatus.SUCCESS:
+                        this.maybe_finish()
+                elif isinstance(reply, ReadNack):
+                    this.done = True
+                    this.result.set_failure(Insufficient(this.txn_id, reply.reason))
+                elif isinstance(reply, CommitNack):
+                    from ..local.commands import CommitOutcome
+                    this.done = True
+                    if reply.outcome is CommitOutcome.REJECTED_BALLOT:
+                        this.result.set_failure(Preempted(this.txn_id, "commit"))
+                    else:
+                        this.result.set_failure(Insufficient(this.txn_id, str(reply.outcome)))
+                else:  # CommitOk
+                    this.on_stable_ack(from_node)
+                    if not this.done:
+                        this.maybe_finish()
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                if this.done:
+                    return
+                if this.stable_tracker.record_failure(from_node) is RequestStatus.FAILED:
+                    this.done = True
+                    this.result.set_failure(Exhausted(this.txn_id, "stabilise"))
+                    return
+                status, retries = this.read_tracker.record_read_failure(from_node)
+                if status is RequestStatus.FAILED:
+                    this.done = True
+                    this.result.set_failure(Exhausted(this.txn_id, "read"))
+                    return
+                for to in retries:
+                    this.send_read_retry(to)
+
+        self.callback = ExecuteCallback()
+        for to in self.stable_tracker.nodes():
+            request = self.commit_for(to, read=to in read_nodes)
+            if request is not None:
+                self.node.send(to, request, self.callback)
+
+    def commit_for(self, to: int, read: bool) -> Optional[Commit]:
+        scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+        if scope is None:
+            return None
+        wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+        ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
+        partial = self.txn.slice(ranges, to == self.node.id)
+        return Commit(self.txn_id, scope, wait_for, self.kind_status, self.execute_at,
+                      partial, self.deps.slice(ranges), read=read)
+
+    def send_read_retry(self, to: int) -> None:
+        request = self.commit_for(to, read=True)
+        if request is not None:
+            self.node.send(to, request, self.callback)
+
+    def on_stable_ack(self, from_node: int) -> None:
+        self.stable_tracker.record_success(from_node)
+
+    def maybe_finish(self) -> None:
+        if self.done:
+            return
+        reads_done = self.read_tracker._all_success(lambda t: t.data_received)
+        stable_done = (not self.require_stable_quorum
+                       or self.stable_tracker.has_reached_quorum())
+        if reads_done and stable_done:
+            self.done = True
+            self.persist()
+
+    # -- Persist (PersistTxn; client callback FIRST) -------------------------
+    def persist(self) -> None:
+        txn_result = self.txn.result(self.txn_id, self.execute_at, self.data)
+        writes = self.txn.execute(self.txn_id, self.execute_at, self.data)
+        self.result.set_success(txn_result)
+
+        for to in self.topologies.nodes():
+            scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+            if scope is None:
+                continue
+            wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+            ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
+            self.node.send(to, Apply(
+                self.txn_id, scope, wait_for, Apply.MINIMAL, self.execute_at,
+                self.deps.slice(ranges), None, writes.slice(ranges), txn_result))
+
+
+def _scope_ranges(node: "Node", scope: Route, max_epoch: int):
+    """The ranges a scope covers (for slicing txn/deps payloads)."""
+    if scope.covering is not None:
+        return scope.covering
+    from ..primitives.keys import Ranges
+    out = Ranges.EMPTY
+    for e in range(node.topology.min_epoch, max_epoch + 1):
+        if node.topology.has_epoch(e):
+            out = out.union(node.topology.topology_for_epoch(e).ranges())
+    return out

@@ -1,0 +1,17 @@
+"""Recovery coordination (Recover.java:80-471) — placeholder pending the recovery
+milestone; see coordinate_transaction for the standard pipeline this resumes into."""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from ..primitives.route import Route
+from ..primitives.timestamp import TxnId
+from ..utils import async_ as au
+from .errors import CoordinationFailed
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+
+def recover(node: "Node", txn_id: TxnId, route: Route, result: au.Settable) -> None:
+    result.set_failure(CoordinationFailed(txn_id, "recovery not yet implemented"))

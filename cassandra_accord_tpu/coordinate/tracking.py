@@ -1,0 +1,218 @@
+"""Per-shard quorum trackers driving coordinator state machines.
+
+Capability parity with ``accord.coordinate.tracking`` (AbstractTracker.java,
+QuorumTracker.java, FastPathTracker.java:33-160, ReadTracker.java,
+RecoveryTracker.java): a tracker owns one ShardTracker per (epoch, shard) across the
+contacted Topologies and aggregates per-shard outcomes into an overall RequestStatus.
+"""
+from __future__ import annotations
+
+import enum
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..topology.topology import Shard, Topologies
+from ..utils.invariants import check_state
+
+
+class RequestStatus(enum.Enum):
+    NO_CHANGE = 0
+    SUCCESS = 1
+    FAILED = 2
+
+
+class ShardTracker:
+    __slots__ = ("shard", "successes", "failures")
+
+    def __init__(self, shard: Shard):
+        self.shard = shard
+        self.successes: Set[int] = set()
+        self.failures: Set[int] = set()
+
+    def has_reached_quorum(self) -> bool:
+        return len(self.successes) >= self.shard.slow_path_quorum_size
+
+    def has_failed(self) -> bool:
+        return len(self.failures) > self.shard.max_failures
+
+    def has_in_flight(self) -> bool:
+        return len(self.successes) + len(self.failures) < self.shard.rf()
+
+
+class AbstractTracker:
+    """Tracks one ShardTracker per unique (epoch, shard)."""
+
+    def __init__(self, topologies: Topologies, tracker_cls=ShardTracker):
+        self.topologies = topologies
+        self.trackers: List = []
+        self._by_node: Dict[int, List] = {}
+        for topology in topologies:
+            for shard in topology.shards:
+                t = tracker_cls(shard)
+                self.trackers.append(t)
+                for n in shard.nodes:
+                    self._by_node.setdefault(n, []).append(t)
+        self.waiting_on_shards = len(self.trackers)
+
+    def nodes(self) -> List[int]:
+        return sorted(self._by_node.keys())
+
+    def trackers_for(self, node: int) -> List:
+        return self._by_node.get(node, [])
+
+    def _all_success(self, predicate) -> bool:
+        return all(predicate(t) for t in self.trackers)
+
+
+class QuorumTracker(AbstractTracker):
+    """Simple-majority per shard (QuorumTracker.java)."""
+
+    def record_success(self, node: int) -> RequestStatus:
+        newly = False
+        for t in self.trackers_for(node):
+            if node in t.successes or node in t.failures:
+                continue
+            pre = t.has_reached_quorum()
+            t.successes.add(node)
+            if not pre and t.has_reached_quorum():
+                newly = True
+        if newly and self._all_success(ShardTracker.has_reached_quorum):
+            return RequestStatus.SUCCESS
+        return RequestStatus.NO_CHANGE
+
+    def record_failure(self, node: int) -> RequestStatus:
+        for t in self.trackers_for(node):
+            if node in t.successes or node in t.failures:
+                continue
+            t.failures.add(node)
+            if t.has_failed():
+                return RequestStatus.FAILED
+        return RequestStatus.NO_CHANGE
+
+    def has_reached_quorum(self) -> bool:
+        return self._all_success(ShardTracker.has_reached_quorum)
+
+
+class FastPathShardTracker(ShardTracker):
+    __slots__ = ("fast_path_accepts", "fast_path_rejects")
+
+    def __init__(self, shard: Shard):
+        super().__init__(shard)
+        self.fast_path_accepts: Set[int] = set()
+        self.fast_path_rejects: Set[int] = set()
+
+    def has_met_fast_path_criteria(self) -> bool:
+        return len(self.fast_path_accepts) >= self.shard.fast_path_quorum_size
+
+    def has_rejected_fast_path(self) -> bool:
+        return self.shard.rejects_fast_path(len(self.fast_path_rejects))
+
+
+class FastPathTracker(AbstractTracker):
+    """PreAccept tracker (FastPathTracker.java:33-160): counts fast-path votes
+    (witnessedAt == txnId) within each shard's electorate alongside the slow-path
+    quorum.  SUCCESS fires once all shards have a slow quorum AND the fast-path
+    outcome is decided (achieved everywhere or rejected somewhere)."""
+
+    def __init__(self, topologies: Topologies):
+        super().__init__(topologies, FastPathShardTracker)
+
+    def record_success(self, node: int, with_fast_path_vote: bool) -> RequestStatus:
+        for t in self.trackers_for(node):
+            if node in t.successes or node in t.failures:
+                continue
+            t.successes.add(node)
+            if node in t.shard.fast_path_electorate:
+                if with_fast_path_vote:
+                    t.fast_path_accepts.add(node)
+                else:
+                    t.fast_path_rejects.add(node)
+        return self._status()
+
+    def record_failure(self, node: int) -> RequestStatus:
+        for t in self.trackers_for(node):
+            if node in t.successes or node in t.failures:
+                continue
+            t.failures.add(node)
+            # an unreachable electorate member can no longer vote for the fast path
+            if node in t.shard.fast_path_electorate:
+                t.fast_path_rejects.add(node)
+            if t.has_failed():
+                return RequestStatus.FAILED
+        return self._status()
+
+    def _status(self) -> RequestStatus:
+        if not self._all_success(ShardTracker.has_reached_quorum):
+            return RequestStatus.NO_CHANGE
+        # quorum reached everywhere: success once fast-path is decided
+        if self.has_fast_path_accepted():
+            return RequestStatus.SUCCESS
+        for t in self.trackers:
+            if not t.has_rejected_fast_path() and not t.has_met_fast_path_criteria() \
+                    and t.has_in_flight():
+                return RequestStatus.NO_CHANGE  # fast path still undecided; keep waiting
+        return RequestStatus.SUCCESS
+
+    def has_fast_path_accepted(self) -> bool:
+        return self._all_success(FastPathShardTracker.has_met_fast_path_criteria)
+
+
+class ReadShardTracker(ShardTracker):
+    __slots__ = ("data_received", "in_flight_reads")
+
+    def __init__(self, shard: Shard):
+        super().__init__(shard)
+        self.data_received = False
+        self.in_flight_reads: Set[int] = set()
+
+
+class ReadTracker(AbstractTracker):
+    """One successful data read per shard, with retry on failure
+    (ReadTracker.java slow-replica speculation simplified to failure-retry)."""
+
+    def __init__(self, topologies: Topologies):
+        super().__init__(topologies, ReadShardTracker)
+        self._contacted: Set[int] = set()
+
+    def initial_contacts(self, prefer: Optional[int] = None) -> List[int]:
+        """Pick one replica per shard (preferring ``prefer`` — normally self)."""
+        out: Set[int] = set()
+        for t in self.trackers:
+            if prefer is not None and prefer in t.shard.nodes:
+                pick = prefer
+            else:
+                pick = t.shard.nodes[0]
+            t.in_flight_reads.add(pick)
+            out.add(pick)
+        self._contacted.update(out)
+        return sorted(out)
+
+    def record_read_success(self, node: int) -> RequestStatus:
+        for t in self.trackers_for(node):
+            if node in t.in_flight_reads:
+                t.in_flight_reads.discard(node)
+                t.data_received = True
+        if self._all_success(lambda t: t.data_received):
+            return RequestStatus.SUCCESS
+        return RequestStatus.NO_CHANGE
+
+    def record_read_failure(self, node: int) -> Tuple[RequestStatus, List[int]]:
+        """Returns (status, additional nodes to contact)."""
+        retries: Set[int] = set()
+        for t in self.trackers_for(node):
+            t.in_flight_reads.discard(node)
+            t.failures.add(node)
+            if t.data_received or t.in_flight_reads:
+                continue
+            candidates = [n for n in t.shard.nodes
+                          if n not in t.failures and n not in t.in_flight_reads]
+            if not candidates:
+                return RequestStatus.FAILED, []
+            pick = candidates[0]
+            t.in_flight_reads.add(pick)
+            retries.add(pick)
+        self._contacted.update(retries)
+        return RequestStatus.NO_CHANGE, sorted(retries)
+
+
+class AppliedTracker(QuorumTracker):
+    """Tracks Apply acks reaching a quorum (AppliedTracker)."""

@@ -1,0 +1,356 @@
+"""Deterministic simulated cluster: one seed => one fully reproducible multi-node run.
+
+Capability parity with ``accord.impl.basic.Cluster`` + ``NodeSink`` +
+``RandomDelayQueue`` (Cluster.java:121-903, NodeSink.java:45, RandomDelayQueue):
+a single-threaded event loop over a priority queue of (virtual-micros, seq, task);
+all network sends, scheduler callbacks and store tasks go through the queue; per-link
+behavior (latency, drop, failure) is pluggable for fault injection.  Simulated time
+advances to each task's deadline — wall-clock independence is what makes every run
+replayable from its seed.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.interfaces import Agent, ConfigurationService, DataStore, EventsListener, MessageSink, Scheduler
+from ..impl.list_store import ListStore
+from ..local.node import Node
+from ..messages.base import Callback, FailureReply, Reply, Request
+from ..primitives.timestamp import Timestamp
+from ..topology.topology import Topology
+from ..utils import async_ as au
+from ..utils.random import RandomSource
+from ..coordinate.errors import Timeout
+
+
+class PendingQueue:
+    """Priority queue keyed by virtual micros; seq breaks ties deterministically."""
+
+    def __init__(self):
+        self._heap: List[Tuple[int, int, Callable]] = []
+        self._seq = 0
+        self.now_micros = 0
+
+    def add(self, at_micros: int, task: Callable[[], None]) -> "PendingQueue._Entry":
+        entry = PendingQueue._Entry(max(at_micros, self.now_micros), self._seq, task)
+        self._seq += 1
+        heapq.heappush(self._heap, entry)
+        return entry
+
+    def add_after(self, delay_micros: int, task: Callable[[], None]):
+        return self.add(self.now_micros + delay_micros, task)
+
+    def pop(self) -> Optional[Callable]:
+        while self._heap:
+            entry = heapq.heappop(self._heap)
+            if entry.cancelled:
+                continue
+            self.now_micros = max(self.now_micros, entry.at)
+            return entry.task
+        return None
+
+    def __len__(self):
+        return sum(1 for e in self._heap if not e.cancelled)
+
+    class _Entry:
+        __slots__ = ("at", "seq", "task", "cancelled")
+
+        def __init__(self, at: int, seq: int, task: Callable):
+            self.at = at
+            self.seq = seq
+            self.task = task
+            self.cancelled = False
+
+        def cancel(self):
+            self.cancelled = True
+
+        def __lt__(self, other):
+            return (self.at, self.seq) < (other.at, other.seq)
+
+
+class SimScheduler(Scheduler):
+    def __init__(self, queue: PendingQueue):
+        self.queue = queue
+
+    def once(self, delay_s: float, run: Callable[[], None]):
+        entry = self.queue.add_after(int(delay_s * 1_000_000), run)
+
+        class _S(Scheduler.Scheduled):
+            def cancel(self_inner):
+                entry.cancel()
+        return _S()
+
+    def recurring(self, interval_s: float, run: Callable[[], None]):
+        state = {"cancelled": False, "entry": None}
+
+        def fire():
+            if state["cancelled"]:
+                return
+            run()
+            state["entry"] = self.queue.add_after(int(interval_s * 1_000_000), fire)
+
+        state["entry"] = self.queue.add_after(int(interval_s * 1_000_000), fire)
+
+        class _S(Scheduler.Scheduled):
+            def cancel(self_inner):
+                state["cancelled"] = True
+                if state["entry"] is not None:
+                    state["entry"].cancel()
+        return _S()
+
+
+class LinkConfig:
+    """Per-link delivery behavior (NodeSink.Action): deliver with latency, drop,
+    or deliver-then-report-failure."""
+
+    DELIVER = "deliver"
+    DROP = "drop"
+    FAILURE = "failure"                  # drop AND report failure to the sender
+    DELIVER_WITH_FAILURE = "deliver_with_failure"  # deliver AND report failure
+
+    def __init__(self, rng: RandomSource, min_latency_us: int = 500,
+                 max_latency_us: int = 20_000):
+        self.rng = rng
+        self.min_latency_us = min_latency_us
+        self.max_latency_us = max_latency_us
+
+    def action(self, from_node: int, to_node: int) -> str:
+        return LinkConfig.DELIVER
+
+    def latency_us(self, from_node: int, to_node: int) -> int:
+        return self.rng.next_int(self.min_latency_us, self.max_latency_us)
+
+
+class SimMessageSink(MessageSink):
+    """Routes messages through the cluster queue with link behavior + reply
+    correlation + caller-side timeouts (SafeCallback semantics)."""
+
+    def __init__(self, node_id: int, cluster: "Cluster"):
+        self.node_id = node_id
+        self.cluster = cluster
+        self._next_msg_id = 0
+        # msg_id -> (callback, timeout_entry, to_node)
+        self.callbacks: Dict[int, Tuple[Callback, object, int]] = {}
+
+    # -- outbound -----------------------------------------------------------
+    def send(self, to: int, request: Request) -> None:
+        self._send(to, request, None)
+
+    def send_with_callback(self, to: int, request: Request, callback: Callback) -> None:
+        self._send(to, request, callback)
+
+    def _send(self, to: int, request: Request, callback: Optional[Callback]) -> None:
+        msg_id = self._next_msg_id
+        self._next_msg_id += 1
+        cluster = self.cluster
+        if callback is not None:
+            timeout_us = int(cluster.reply_timeout_s * 1_000_000)
+            entry = cluster.queue.add_after(timeout_us, lambda: self._timeout(msg_id))
+            self.callbacks[msg_id] = (callback, entry, to)
+        cluster.route(self.node_id, to, request, msg_id, callback is not None)
+
+    def reply(self, to: int, reply_context, reply: Reply) -> None:
+        self.cluster.route_reply(self.node_id, to, reply_context, reply)
+
+    # -- inbound correlation -------------------------------------------------
+    def deliver_reply(self, from_node: int, msg_id: int, reply: Reply) -> None:
+        entry = self.callbacks.get(msg_id)
+        if entry is None:
+            return
+        callback, timeout_entry, _to = entry
+        if reply.is_final:
+            del self.callbacks[msg_id]
+            timeout_entry.cancel()
+        try:
+            if isinstance(reply, FailureReply):
+                callback.on_failure(from_node, reply.failure)
+            else:
+                callback.on_success(from_node, reply)
+        except BaseException as e:  # noqa: BLE001
+            callback.on_callback_failure(from_node, e)
+
+    def report_failure(self, msg_id: int, to_node: int, failure: BaseException) -> None:
+        entry = self.callbacks.pop(msg_id, None)
+        if entry is None:
+            return
+        callback, timeout_entry, _ = entry
+        timeout_entry.cancel()
+        try:
+            callback.on_failure(to_node, failure)
+        except BaseException as e:  # noqa: BLE001
+            callback.on_callback_failure(to_node, e)
+
+    def _timeout(self, msg_id: int) -> None:
+        entry = self.callbacks.pop(msg_id, None)
+        if entry is None:
+            return
+        callback, _timeout_entry, to = entry
+        try:
+            callback.on_failure(to, Timeout(None, f"no reply from {to}"))
+        except BaseException as e:  # noqa: BLE001
+            callback.on_callback_failure(to, e)
+
+
+class ReplyContext:
+    __slots__ = ("reply_to", "msg_id")
+
+    def __init__(self, reply_to: int, msg_id: int):
+        self.reply_to = reply_to
+        self.msg_id = msg_id
+
+
+class SimConfigService(ConfigurationService):
+    """Static/global epoch feed shared by all nodes (BurnTestConfigurationService
+    simplified): the cluster appends topologies; every node learns them through the
+    queue."""
+
+    def __init__(self, cluster: "Cluster", node_id: int):
+        self.cluster = cluster
+        self.node_id = node_id
+        self.listeners: List[ConfigurationService.Listener] = []
+
+    def register_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    def current_topology(self) -> Topology:
+        return self.cluster.topologies[-1]
+
+    def get_topology_for_epoch(self, epoch: int) -> Optional[Topology]:
+        for t in self.cluster.topologies:
+            if t.epoch == epoch:
+                return t
+        return None
+
+    def fetch_topology_for_epoch(self, epoch: int) -> None:
+        t = self.get_topology_for_epoch(epoch)
+        if t is not None:
+            self.cluster.queue.add_after(0, lambda: self.notify(t))
+
+    def notify(self, topology: Topology) -> None:
+        for listener in self.listeners:
+            listener.on_topology_update(topology, start_sync=True)
+
+    def acknowledge_epoch(self, ready, start_sync: bool) -> None:
+        # report sync completion to all peers once the epoch is locally ready
+        epoch = ready.epoch
+        me = self.node_id
+
+        def broadcast():
+            for other in self.cluster.nodes.values():
+                other.on_remote_sync_complete(me, epoch)
+        ready.reads.add_listener(lambda v, f: broadcast())
+
+
+class SimAgent(Agent):
+    def __init__(self, cluster: "Cluster"):
+        self.cluster = cluster
+
+    def on_uncaught_exception(self, failure: BaseException) -> None:
+        self.cluster.failures.append(failure)
+        raise failure
+
+    def on_handled_exception(self, failure: BaseException) -> None:
+        pass
+
+    def pre_accept_timeout(self) -> float:
+        return 1.0
+
+
+class Cluster:
+    """In-process multi-node Accord cluster on simulated time."""
+
+    def __init__(self, topology: Topology, seed: int = 1, num_shards: int = 1,
+                 link_config: Optional[LinkConfig] = None,
+                 reply_timeout_s: float = 2.0):
+        self.rng = RandomSource(seed)
+        self.queue = PendingQueue()
+        self.scheduler = SimScheduler(self.queue)
+        self.topologies: List[Topology] = [topology]
+        self.link = link_config or LinkConfig(self.rng.fork())
+        self.reply_timeout_s = reply_timeout_s
+        self.failures: List[BaseException] = []
+        self.stats: Dict[str, int] = {}
+        self.nodes: Dict[int, Node] = {}
+        self.sinks: Dict[int, SimMessageSink] = {}
+        self.stores: Dict[int, ListStore] = {}
+        agent = SimAgent(self)
+        for node_id in sorted(topology.nodes()):
+            sink = SimMessageSink(node_id, self)
+            store = ListStore(node_id)
+            self.sinks[node_id] = sink
+            self.stores[node_id] = store
+            self.nodes[node_id] = Node(
+                node_id, sink, SimConfigService(self, node_id), agent,
+                self.scheduler, store, self.rng.fork(),
+                now_micros=lambda: self.queue.now_micros,
+                num_shards=num_shards)
+
+    # -- message routing ----------------------------------------------------
+    def route(self, from_node: int, to_node: int, request: Request, msg_id: int,
+              has_callback: bool) -> None:
+        self._count(f"{type(request).__name__}")
+        action = self.link.action(from_node, to_node) if from_node != to_node \
+            else LinkConfig.DELIVER
+        if action in (LinkConfig.DROP, LinkConfig.FAILURE):
+            if action == LinkConfig.FAILURE and has_callback:
+                self.queue.add_after(
+                    self.link.latency_us(from_node, to_node),
+                    lambda: self.sinks[from_node].report_failure(
+                        msg_id, to_node, ConnectionError(f"link {from_node}->{to_node}")))
+            return
+        latency = 0 if from_node == to_node else self.link.latency_us(from_node, to_node)
+        ctx = ReplyContext(from_node, msg_id)
+        self.queue.add_after(latency, lambda: self.nodes[to_node].receive(
+            request, from_node, ctx))
+        if action == LinkConfig.DELIVER_WITH_FAILURE and has_callback:
+            self.queue.add_after(
+                self.link.latency_us(from_node, to_node),
+                lambda: self.sinks[from_node].report_failure(
+                    msg_id, to_node, ConnectionError(f"link {from_node}->{to_node}")))
+
+    def route_reply(self, from_node: int, to_node: int, reply_context: ReplyContext,
+                    reply: Reply) -> None:
+        self._count(f"{type(reply).__name__}")
+        action = self.link.action(from_node, to_node) if from_node != to_node \
+            else LinkConfig.DELIVER
+        if action in (LinkConfig.DROP, LinkConfig.FAILURE):
+            return
+        latency = 0 if from_node == to_node else self.link.latency_us(from_node, to_node)
+        self.queue.add_after(latency, lambda: self.sinks[to_node].deliver_reply(
+            from_node, reply_context.msg_id, reply))
+
+    def _count(self, key: str) -> None:
+        self.stats[key] = self.stats.get(key, 0) + 1
+
+    # -- execution ----------------------------------------------------------
+    def run_until_idle(self, max_tasks: int = 1_000_000) -> int:
+        """Drain the queue; returns tasks executed. Raises any node failure."""
+        n = 0
+        while n < max_tasks:
+            task = self.queue.pop()
+            if task is None:
+                break
+            task()
+            n += 1
+            if self.failures:
+                raise self.failures[0]
+        return n
+
+    def run_until(self, predicate: Callable[[], bool], max_tasks: int = 1_000_000) -> bool:
+        n = 0
+        while n < max_tasks:
+            if predicate():
+                return True
+            task = self.queue.pop()
+            if task is None:
+                return predicate()
+            task()
+            n += 1
+            if self.failures:
+                raise self.failures[0]
+        return predicate()
+
+    @property
+    def now_micros(self) -> int:
+        return self.queue.now_micros

@@ -1,0 +1,142 @@
+"""List-append KV model store for the harness and Maelstrom adapter.
+
+Capability parity with the reference's ``accord.impl.list`` test model
+(ListStore.java 599 LoC, ListRead/ListUpdate/ListQuery/ListData/ListResult): every key
+holds a list of appended values with their apply timestamps; writes append a value,
+reads return the list contents.  The burn test's strict-serializability verifier
+consumes exactly this read/append observation model.
+"""
+from __future__ import annotations
+
+from bisect import insort
+from typing import Dict, List, Optional, Tuple
+
+from ..api.interfaces import Data, DataStore, Query, Read, Result, Update, Write
+from ..primitives.keys import Key, Keys, Ranges
+from ..primitives.timestamp import Timestamp, TxnId
+from ..utils import async_ as au
+
+
+class ListStore(DataStore):
+    """In-memory per-node storage: key -> sorted list of (executeAt, value)."""
+
+    def __init__(self, node_id: int = 0):
+        self.node_id = node_id
+        self.data: Dict[Key, List[Tuple[Timestamp, object]]] = {}
+
+    def get(self, key: Key) -> Tuple[object, ...]:
+        return tuple(v for _, v in self.data.get(key, ()))
+
+    def append(self, key: Key, execute_at: Timestamp, value: object) -> None:
+        entries = self.data.setdefault(key, [])
+        # idempotent: the same (executeAt, value) may be applied once
+        for ts, _ in entries:
+            if ts == execute_at:
+                return
+        insort(entries, (execute_at, value))
+
+    def fetch(self, node, safe_store, ranges, sync_point, fetch_ranges):
+        # in-memory bootstrap: nothing to stream in unit tests; report fetched
+        fetch_ranges.fetched(ranges)
+        return au.success_result()
+
+
+class ListData(Data):
+    """key -> tuple of values observed by the read."""
+
+    def __init__(self, entries: Optional[Dict[Key, Tuple]] = None):
+        self.entries: Dict[Key, Tuple] = entries or {}
+
+    def merge(self, other: "Data") -> "Data":
+        if not isinstance(other, ListData):
+            return self
+        merged = dict(self.entries)
+        merged.update(other.entries)
+        return ListData(merged)
+
+    def __repr__(self):
+        return f"ListData({self.entries})"
+
+
+class ListRead(Read):
+    def __init__(self, keys: Keys):
+        self._keys = keys
+
+    def keys(self):
+        return self._keys
+
+    def read(self, key, safe_store, execute_at, data_store) -> au.AsyncChain:
+        return au.done(ListData({key: data_store.get(key)}))
+
+    def slice(self, ranges: Ranges) -> "ListRead":
+        return ListRead(self._keys.slice(ranges))
+
+    def merge(self, other: "Read") -> "ListRead":
+        return ListRead(self._keys.union(other._keys))
+
+
+class ListWrite(Write):
+    """Computed appends: key -> value."""
+
+    def __init__(self, appends: Dict[Key, object]):
+        self.appends = appends
+
+    def apply(self, store: ListStore, key, execute_at) -> au.AsyncChain:
+        if key in self.appends:
+            store.append(key, execute_at, self.appends[key])
+        return au.done(None)
+
+
+class ListUpdate(Update):
+    """key -> value to append."""
+
+    def __init__(self, appends: Dict[Key, object]):
+        self.appends = appends
+
+    def keys(self):
+        return Keys.of(self.appends.keys())
+
+    def apply(self, execute_at, data) -> ListWrite:
+        return ListWrite(dict(self.appends))
+
+    def slice(self, ranges: Ranges) -> "ListUpdate":
+        return ListUpdate({k: v for k, v in self.appends.items()
+                           if ranges.contains(k.to_routing())})
+
+    def merge(self, other: "Update") -> "ListUpdate":
+        merged = dict(self.appends)
+        merged.update(other.appends)
+        return ListUpdate(merged)
+
+
+class ListResult(Result):
+    """Client-visible result: what the txn read (key -> tuple) and wrote."""
+
+    def __init__(self, txn_id: TxnId, execute_at, reads: Dict[Key, Tuple],
+                 writes: Dict[Key, object]):
+        self.txn_id = txn_id
+        self.execute_at = execute_at
+        self.reads = reads
+        self.writes = writes
+
+    def __repr__(self):
+        return f"ListResult({self.txn_id!r}, reads={self.reads}, writes={self.writes})"
+
+
+class ListQuery(Query):
+    def __init__(self):
+        pass
+
+    def compute(self, txn_id, execute_at, keys, data, read, update) -> ListResult:
+        reads = dict(data.entries) if isinstance(data, ListData) else {}
+        writes = dict(update.appends) if isinstance(update, ListUpdate) else {}
+        return ListResult(txn_id, execute_at, reads, writes)
+
+
+def list_txn(keys_read: List[Key], appends: Dict[Key, object]):
+    """Build a list-model Txn: read ``keys_read``, append ``appends``."""
+    from ..primitives.txn import Txn
+    all_keys = Keys.of(list(keys_read) + list(appends.keys()))
+    read = ListRead(Keys.of(keys_read))
+    update = ListUpdate(appends) if appends else None
+    return Txn.of(all_keys, read, update, ListQuery())

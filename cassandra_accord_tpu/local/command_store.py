@@ -1,0 +1,364 @@
+"""Command stores: single-logical-thread metadata shards and their manager.
+
+Capability parity with ``accord.local.CommandStore/CommandStores/SafeCommandStore``
+(CommandStore.java:1-788, CommandStores.java:79-737, SafeCommandStore.java:58-385):
+each store owns a set of key ranges per epoch, all per-txn ``Command`` state and
+per-key ``CommandsForKey`` indexes for those ranges, and executes every operation on
+its own executor (one logical thread).  ``SafeCommandStore`` is the transactional view
+handed to in-store operations, exposing the dependency-calculation queries
+(``map_reduce_active``) and listener plumbing.  ``CommandStores`` routes operations to
+the stores whose ranges intersect the operation's keys (``map_reduce_consume``
+semantics) and swaps range assignments on topology change.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Set, Tuple
+
+from ..api.interfaces import Agent, DataStore, ProgressLog
+from ..primitives.deps import Deps
+from ..primitives.keys import Range, Ranges, RoutingKey
+from ..primitives.route import Route
+from ..primitives.timestamp import Timestamp, TxnId, TxnKind
+from ..utils import async_ as au
+from ..utils.invariants import Invariants, check_state
+from .cfk import CommandsForKey, InternalStatus, manages, manages_execution
+from .command import Command
+from .status import SaveStatus, Status
+
+if TYPE_CHECKING:
+    from .node import Node
+
+
+class AgentExecutor:
+    """Executor + agent pair (local/AgentExecutor.java). The default executes
+    inline; the simulation harness substitutes a deterministic task queue."""
+
+    def __init__(self, agent: Agent):
+        self.agent = agent
+
+    def execute(self, task: Callable[[], None]) -> None:
+        try:
+            task()
+        except BaseException as e:  # noqa: BLE001
+            self.agent.on_uncaught_exception(e)
+
+    def submit(self, task: Callable[[], object]) -> au.AsyncChain:
+        return au.of_callable(task, executor=self)
+
+
+class CommandStore:
+    """One metadata shard of one node."""
+
+    _current: Optional["CommandStore"] = None   # logical-thread discipline check
+
+    def __init__(self, store_id: int, node: "Node", executor: AgentExecutor):
+        self.id = store_id
+        self.node = node
+        self.executor = executor
+        # epoch -> Ranges this store covers (RangesForEpoch)
+        self.ranges_by_epoch: Dict[int, Ranges] = {}
+        self.commands: Dict[TxnId, Command] = {}
+        self.cfks: Dict[RoutingKey, CommandsForKey] = {}
+        # witnessed range-domain txns: TxnId -> (Ranges, status) for range deps calc
+        # (InMemoryCommandStore.rangeCommands equivalent)
+        self.range_txns: Dict[TxnId, Tuple[Ranges, InternalStatus]] = {}
+        # transient listeners: txn_id -> callbacks fired on every status change
+        self.transient_listeners: Dict[TxnId, List[Callable]] = {}
+        # max executeAt witnessed per key-space (MaxConflicts): tracked coarsely
+        # store-wide plus per-key via cfk.max_timestamp
+        self.max_conflict_ts: Optional[Timestamp] = None
+        self.progress_log: ProgressLog = ProgressLog.NOOP
+
+    # -- ranges -------------------------------------------------------------
+    def update_ranges(self, epoch: int, ranges: Ranges) -> None:
+        self.ranges_by_epoch[epoch] = ranges
+
+    def ranges_at(self, epoch: int) -> Ranges:
+        """Ranges covered at ``epoch`` (latest known at-or-before epoch)."""
+        best_e = None
+        for e in self.ranges_by_epoch:
+            if e <= epoch and (best_e is None or e > best_e):
+                best_e = e
+        return self.ranges_by_epoch.get(best_e, Ranges.EMPTY) if best_e is not None else Ranges.EMPTY
+
+    def current_ranges(self) -> Ranges:
+        if not self.ranges_by_epoch:
+            return Ranges.EMPTY
+        return self.ranges_by_epoch[max(self.ranges_by_epoch)]
+
+    def all_ranges(self) -> Ranges:
+        out = Ranges.EMPTY
+        for r in self.ranges_by_epoch.values():
+            out = out.union(r)
+        return out
+
+    # -- execution ----------------------------------------------------------
+    def execute(self, task: Callable[["SafeCommandStore"], None]) -> None:
+        def run():
+            prev, CommandStore._current = CommandStore._current, self
+            try:
+                task(SafeCommandStore(self))
+            finally:
+                CommandStore._current = prev
+        self.executor.execute(run)
+
+    def submit(self, task: Callable[["SafeCommandStore"], object]) -> au.AsyncChain:
+        def run():
+            prev, CommandStore._current = CommandStore._current, self
+            try:
+                return task(SafeCommandStore(self))
+            finally:
+                CommandStore._current = prev
+        return self.executor.submit(run)
+
+    def check_in_store(self) -> None:
+        Invariants.check_state(CommandStore._current is self,
+                               "operation invoked outside its CommandStore")
+
+    def agent(self) -> Agent:
+        return self.executor.agent
+
+    def __repr__(self) -> str:
+        return f"CommandStore({self.id}@{self.node.id}, {self.current_ranges()!r})"
+
+
+class SafeCommandStore:
+    """Transactional view passed to every in-store operation."""
+
+    __slots__ = ("store",)
+
+    def __init__(self, store: CommandStore):
+        self.store = store
+
+    # -- commands -----------------------------------------------------------
+    def get_or_create(self, txn_id: TxnId) -> Command:
+        cmd = self.store.commands.get(txn_id)
+        if cmd is None:
+            cmd = Command(txn_id)
+            self.store.commands[txn_id] = cmd
+        return cmd
+
+    def get_if_exists(self, txn_id: TxnId) -> Optional[Command]:
+        return self.store.commands.get(txn_id)
+
+    # -- cfk ----------------------------------------------------------------
+    def cfk(self, key: RoutingKey) -> CommandsForKey:
+        c = self.store.cfks.get(key)
+        if c is None:
+            c = CommandsForKey(key)
+            self.store.cfks[key] = c
+        return c
+
+    def cfk_if_exists(self, key: RoutingKey) -> Optional[CommandsForKey]:
+        return self.store.cfks.get(key)
+
+    # -- deps queries (SafeCommandStore.mapReduceActive, :292) ---------------
+    def map_reduce_active(self, keys, ranges, before: Timestamp,
+                          witnesses: Callable[[TxnId], bool],
+                          visit: Callable[[object, TxnId], None]) -> None:
+        """Visit (key_or_range, dep_txn_id) for every active txn with txnId < before
+        that conflicts with the given keys/ranges and is witnessed by the caller.
+
+        - key footprint: consult each key's CommandsForKey;
+        - plus range txns whose ranges intersect the keys;
+        - range footprint: all cfk txns on keys within the ranges + intersecting
+          range txns (InMemoryCommandStore range scan fallback :814-900).
+        """
+        local = self.store.current_ranges()
+        if keys is not None:
+            for key in keys:
+                rk = key.to_routing() if hasattr(key, "to_routing") else key
+                if not local.contains(rk):
+                    continue
+                cfk = self.cfk_if_exists(rk)
+                if cfk is not None:
+                    cfk.map_reduce_active(before, witnesses, lambda t, _k=key: visit(_k, t))
+                for tid, (rngs, status) in self.store.range_txns.items():
+                    if tid < before and status is not InternalStatus.INVALIDATED \
+                            and witnesses(tid) and rngs.contains(rk):
+                        visit(key, tid)
+        if ranges is not None:
+            for rng in ranges:
+                for rk, cfk in self.store.cfks.items():
+                    if rng.contains(rk) and local.contains(rk):
+                        cfk.map_reduce_active(before, witnesses, lambda t, _rk=rk: visit(_rk, t))
+                for tid, (rngs, status) in self.store.range_txns.items():
+                    if tid < before and status is not InternalStatus.INVALIDATED \
+                            and witnesses(tid) and rngs.intersects(rng):
+                        visit(rng, tid)
+
+    def max_conflict(self, keys, ranges) -> Optional[Timestamp]:
+        """Max txnId/executeAt witnessed intersecting the footprint (MaxConflicts)."""
+        out: Optional[Timestamp] = None
+
+        def bump(ts: Optional[Timestamp]):
+            nonlocal out
+            if ts is not None and (out is None or ts > out):
+                out = ts
+
+        if keys is not None:
+            for key in keys:
+                rk = key.to_routing() if hasattr(key, "to_routing") else key
+                cfk = self.cfk_if_exists(rk)
+                if cfk is not None:
+                    bump(cfk.max_timestamp())
+        if ranges is not None and self.store.cfks:
+            for rng in ranges:
+                for rk, cfk in self.store.cfks.items():
+                    if rng.contains(rk):
+                        bump(cfk.max_timestamp())
+        # range txns conflict with everything they cover
+        for tid, (rngs, _status) in self.store.range_txns.items():
+            if keys is not None and any(rngs.contains(k.to_routing() if hasattr(k, "to_routing") else k) for k in keys):
+                bump(tid)
+            if ranges is not None and any(rngs.intersects(r) for r in ranges):
+                bump(tid)
+        return out
+
+    # -- registration -------------------------------------------------------
+    def register_witness(self, command: Command, status: InternalStatus) -> None:
+        """Index a txn in the per-key / range structures for deps calculation."""
+        scope = command.route.participants() if command.route is not None else None
+        if scope is None:
+            return
+        local = self.store.current_ranges()
+        if isinstance(scope, Ranges):
+            prev = self.store.range_txns.get(command.txn_id)
+            rngs = scope.intersection(local)
+            # keep the max status seen
+            if prev is None or status > prev[1]:
+                self.store.range_txns[command.txn_id] = (rngs, status)
+            ts = command.execute_at if command.execute_at is not None else command.txn_id
+            if self.store.max_conflict_ts is None or ts > self.store.max_conflict_ts:
+                self.store.max_conflict_ts = ts
+        else:
+            ea = command.execute_at
+            for rk in scope:
+                if local.contains(rk):
+                    self.cfk(rk).update(command.txn_id, status, ea)
+
+    # -- listeners -----------------------------------------------------------
+    def add_transient_listener(self, txn_id: TxnId, callback: Callable) -> None:
+        self.store.transient_listeners.setdefault(txn_id, []).append(callback)
+
+    def notify_listeners(self, command: Command) -> None:
+        """Fire command-listeners (dependent txns) and transient listeners."""
+        from . import commands as C
+        for waiter_id in list(command.listeners):
+            waiter = self.get_if_exists(waiter_id)
+            if waiter is not None:
+                C.update_dependency_and_maybe_execute(self, waiter, command)
+        for cb in list(self.store.transient_listeners.get(command.txn_id, ())):
+            cb(self, command)
+
+    def remove_transient_listener(self, txn_id: TxnId, callback: Callable) -> None:
+        lst = self.store.transient_listeners.get(txn_id)
+        if lst and callback in lst:
+            lst.remove(callback)
+            if not lst:
+                del self.store.transient_listeners[txn_id]
+
+    # -- context ------------------------------------------------------------
+    def data_store(self) -> DataStore:
+        return self.store.node.data_store
+
+    def agent(self) -> Agent:
+        return self.store.agent()
+
+    def progress_log(self) -> ProgressLog:
+        return self.store.progress_log
+
+    def time(self):
+        return self.store.node
+
+    def ranges_at(self, epoch: int) -> Ranges:
+        return self.store.ranges_at(epoch)
+
+    def current_ranges(self) -> Ranges:
+        return self.store.current_ranges()
+
+    def node(self) -> "Node":
+        return self.store.node
+
+
+class CommandStores:
+    """Shard manager: routes operations to intersecting stores
+    (CommandStores.java mapReduceConsume :580-620, updateTopology :402-482)."""
+
+    def __init__(self, node: "Node", num_shards: int = 1,
+                 executor_factory: Optional[Callable[[int], AgentExecutor]] = None):
+        self.node = node
+        self.num_shards = num_shards
+        factory = executor_factory or (lambda i: AgentExecutor(node.agent))
+        self.stores: List[CommandStore] = [
+            CommandStore(i, node, factory(i)) for i in range(num_shards)
+        ]
+        # sticky range -> store assignment: a range must stay with the store that
+        # holds its Command/cfk history across topology changes
+        self._assignment: Dict[Range, int] = {}
+
+    # -- topology -----------------------------------------------------------
+    def update_topology(self, topology) -> None:
+        """Distribute this node's ranges across stores. Previously-assigned ranges
+        keep their store (their command/cfk state lives there); new ranges go to the
+        least-loaded store (ShardDistributor.EvenSplit semantics)."""
+        my_ranges = topology.ranges_for_node(self.node.id)
+        buckets: List[List[Range]] = [[] for _ in self.stores]
+        unassigned: List[Range] = []
+        for rng in my_ranges:
+            sid = self._assignment.get(rng)
+            if sid is not None:
+                buckets[sid].append(rng)
+            else:
+                unassigned.append(rng)
+        for rng in unassigned:
+            sid = min(range(len(buckets)), key=lambda i: len(buckets[i]))
+            self._assignment[rng] = sid
+            buckets[sid].append(rng)
+        for store, bucket in zip(self.stores, buckets):
+            store.update_ranges(topology.epoch, Ranges.of(*bucket))
+
+    # -- routing ------------------------------------------------------------
+    def intersecting_stores(self, unseekables, min_epoch: int, max_epoch: int) -> List[CommandStore]:
+        if isinstance(unseekables, Route):
+            unseekables = unseekables.participants()
+        out = []
+        for store in self.stores:
+            for e in range(min_epoch, max_epoch + 1):
+                ranges = store.ranges_at(e)
+                if ranges and unseekables is not None and ranges.intersects(unseekables):
+                    out.append(store)
+                    break
+                if ranges and unseekables is None:
+                    out.append(store)
+                    break
+        return out
+
+    def map_reduce(self, unseekables, min_epoch: int, max_epoch: int,
+                   map_fn: Callable[[SafeCommandStore], object],
+                   reduce_fn: Callable[[object, object], object]) -> au.AsyncChain:
+        """Run map_fn in every intersecting store (on its executor), reduce results."""
+        stores = self.intersecting_stores(unseekables, min_epoch, max_epoch)
+        if not stores:
+            return au.done(None)
+        chains = [s.submit(map_fn) for s in stores]
+
+        def reduce_all(results):
+            acc = None
+            first = True
+            for r in results:
+                if first:
+                    acc, first = r, False
+                else:
+                    acc = reduce_fn(acc, r)
+            return acc
+
+        return au.all_of(chains).map(reduce_all)
+
+    def for_each(self, unseekables, min_epoch: int, max_epoch: int,
+                 fn: Callable[[SafeCommandStore], None]) -> au.AsyncChain:
+        return self.map_reduce(unseekables, min_epoch, max_epoch,
+                               lambda s: (fn(s), None)[1], lambda a, b: None)
+
+    def all_stores(self) -> List[CommandStore]:
+        return list(self.stores)

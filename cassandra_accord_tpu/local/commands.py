@@ -1,0 +1,359 @@
+"""ALL command state transitions.
+
+Capability parity with ``accord.local.Commands`` (Commands.java:106-1293): static
+functions operating on (SafeCommandStore, Command): preaccept, accept,
+accept_invalidate, commit/precommit/stable, commit_invalidate, apply, maybe_execute,
+the WaitingOn initialisation/update machinery, and durability marking.  Every
+transition is ballot-gated and monotonic; listeners (dependent commands and transient
+message waiters) are notified on every status change.
+"""
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING, Optional
+
+from ..primitives.deps import Deps
+from ..primitives.keys import Ranges
+from ..primitives.route import Route
+from ..primitives.timestamp import Ballot, Timestamp, TxnId
+from ..primitives.txn import PartialTxn, Writes
+from ..utils.invariants import Invariants, check_state
+from .cfk import InternalStatus, manages_execution
+from .command import Command, WaitingOn
+from .command_store import SafeCommandStore
+from .status import Durability, SaveStatus, Status
+
+if TYPE_CHECKING:
+    from ..api.interfaces import Result
+
+
+class AcceptOutcome(enum.Enum):
+    SUCCESS = 0
+    REDUNDANT = 1          # already progressed past this phase
+    REJECTED_BALLOT = 2
+    INSUFFICIENT = 3       # missing definition (recovery edge)
+    TRUNCATED = 4
+
+
+# ---------------------------------------------------------------------------
+# PreAccept (Commands.java:113)
+# ---------------------------------------------------------------------------
+
+def preaccept(safe_store: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
+              route: Route, ballot: Ballot = Ballot.ZERO) -> AcceptOutcome:
+    """Witness the txn; propose witnessedAt = txnId if no conflict is later, else a
+    fresh unique timestamp greater than every conflict (PreAccept.java:245-267)."""
+    command = safe_store.get_or_create(txn_id)
+    if command.save_status.is_truncated:
+        return AcceptOutcome.TRUNCATED
+    if command.has_been(Status.PRE_ACCEPTED):
+        # duplicate delivery / recovery re-preaccept: report current state
+        if ballot < command.promised:
+            return AcceptOutcome.REJECTED_BALLOT
+        return AcceptOutcome.REDUNDANT
+    if ballot < command.promised:
+        return AcceptOutcome.REJECTED_BALLOT
+
+    command.route = route if command.route is None else command.route.union(route)
+    command.partial_txn = partial_txn
+    command.promised = command.promised.merge_max(ballot)
+
+    # timestamp proposal
+    keys = partial_txn.keys if not isinstance(partial_txn.keys, Ranges) else None
+    ranges = partial_txn.keys if isinstance(partial_txn.keys, Ranges) else None
+    max_conflict = safe_store.max_conflict(keys, ranges)
+    if max_conflict is None or max_conflict < txn_id:
+        command.execute_at = txn_id.as_timestamp()
+    else:
+        command.execute_at = safe_store.time().unique_now_at_least(max_conflict)
+    command.set_save_status(SaveStatus.PRE_ACCEPTED)
+    safe_store.register_witness(command, InternalStatus.PREACCEPTED)
+    safe_store.progress_log().pre_accepted(command, _is_progress_shard(safe_store, command))
+    safe_store.notify_listeners(command)
+    return AcceptOutcome.SUCCESS
+
+
+# ---------------------------------------------------------------------------
+# Accept — slow-path proposal (Commands.java:202)
+# ---------------------------------------------------------------------------
+
+def accept(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
+           execute_at: Timestamp, partial_deps: Deps) -> AcceptOutcome:
+    command = safe_store.get_or_create(txn_id)
+    if command.save_status.is_truncated:
+        return AcceptOutcome.TRUNCATED
+    if command.has_been(Status.PRE_COMMITTED):
+        return AcceptOutcome.REDUNDANT
+    if ballot < command.promised:
+        return AcceptOutcome.REJECTED_BALLOT
+
+    command.route = route if command.route is None else command.route.union(route)
+    command.promised = command.promised.merge_max(ballot)
+    command.accepted_or_committed = ballot
+    command.execute_at = execute_at
+    command.partial_deps = partial_deps
+    command.set_save_status(SaveStatus.ACCEPTED)
+    safe_store.register_witness(command, InternalStatus.ACCEPTED)
+    safe_store.progress_log().accepted(command, _is_progress_shard(safe_store, command))
+    safe_store.notify_listeners(command)
+    return AcceptOutcome.SUCCESS
+
+
+def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot) -> AcceptOutcome:
+    """Promise not to accept anything below ballot, voting for invalidation
+    (Commands.java:250)."""
+    command = safe_store.get_or_create(txn_id)
+    if command.save_status.is_truncated:
+        return AcceptOutcome.TRUNCATED
+    if command.has_been(Status.PRE_COMMITTED):
+        return AcceptOutcome.REDUNDANT
+    if ballot < command.promised:
+        return AcceptOutcome.REJECTED_BALLOT
+    command.promised = command.promised.merge_max(ballot)
+    if command.save_status < SaveStatus.ACCEPTED_INVALIDATE:
+        command.set_save_status(SaveStatus.ACCEPTED_INVALIDATE)
+    safe_store.notify_listeners(command)
+    return AcceptOutcome.SUCCESS
+
+
+# ---------------------------------------------------------------------------
+# Commit / Stable (Commands.java:289,353)
+# ---------------------------------------------------------------------------
+
+class CommitOutcome(enum.Enum):
+    SUCCESS = 0
+    REDUNDANT = 1
+    REJECTED_BALLOT = 2
+    INSUFFICIENT = 3
+
+
+def precommit(safe_store: SafeCommandStore, txn_id: TxnId, execute_at: Timestamp) -> CommitOutcome:
+    """Mark executeAt agreed without deps (Commands.java:353)."""
+    command = safe_store.get_or_create(txn_id)
+    if command.has_been(Status.PRE_COMMITTED):
+        _check_consistent_execute_at(safe_store, command, execute_at)
+        return CommitOutcome.REDUNDANT
+    command.execute_at = execute_at
+    command.set_save_status(SaveStatus.PRE_COMMITTED)
+    safe_store.progress_log().precommitted(command)
+    safe_store.notify_listeners(command)
+    return CommitOutcome.SUCCESS
+
+
+def commit(safe_store: SafeCommandStore, txn_id: TxnId, save_status: SaveStatus,
+           ballot: Ballot, route: Route, partial_txn: Optional[PartialTxn],
+           execute_at: Timestamp, partial_deps: Deps) -> CommitOutcome:
+    """CommitSlowPath (-> COMMITTED) or Stable* (-> STABLE + initialise WaitingOn +
+    maybe_execute) — Commands.java:289."""
+    check_state(save_status in (SaveStatus.COMMITTED, SaveStatus.STABLE),
+                "commit called with %s", save_status)
+    command = safe_store.get_or_create(txn_id)
+    if command.save_status.is_truncated or command.save_status is SaveStatus.INVALIDATED:
+        return CommitOutcome.REDUNDANT
+    if save_status is SaveStatus.COMMITTED and command.has_been(Status.COMMITTED):
+        _check_consistent_execute_at(safe_store, command, execute_at)
+        return CommitOutcome.REDUNDANT
+    if command.has_been(Status.STABLE):
+        _check_consistent_execute_at(safe_store, command, execute_at)
+        return CommitOutcome.REDUNDANT
+    if ballot < command.promised:
+        return CommitOutcome.REJECTED_BALLOT
+
+    command.route = route if command.route is None else command.route.union(route)
+    if partial_txn is not None:
+        command.partial_txn = partial_txn if command.partial_txn is None \
+            else command.partial_txn.with_merged(partial_txn)
+    if command.partial_txn is None:
+        return CommitOutcome.INSUFFICIENT
+    command.accepted_or_committed = command.accepted_or_committed.merge_max(ballot)
+    command.execute_at = execute_at
+    command.partial_deps = partial_deps
+    command.set_save_status(save_status)
+    safe_store.register_witness(command, InternalStatus.COMMITTED if save_status is SaveStatus.COMMITTED
+                                else InternalStatus.STABLE)
+    if save_status is SaveStatus.STABLE:
+        initialise_waiting_on(safe_store, command)
+        safe_store.progress_log().stable(command, _is_progress_shard(safe_store, command))
+        maybe_execute(safe_store, command, always_notify_listeners=True)
+    else:
+        safe_store.notify_listeners(command)
+    return CommitOutcome.SUCCESS
+
+
+def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId) -> None:
+    """Commands.java:434."""
+    command = safe_store.get_or_create(txn_id)
+    if command.has_been(Status.PRE_COMMITTED) and command.save_status is not SaveStatus.INVALIDATED:
+        # a txn cannot be both committed and invalidated
+        safe_store.agent().on_inconsistent_timestamp(command, command.execute_at, None)
+        return
+    if command.save_status is SaveStatus.INVALIDATED:
+        return
+    command.set_save_status(SaveStatus.INVALIDATED)
+    safe_store.register_witness(command, InternalStatus.INVALIDATED)
+    safe_store.progress_log().invalidated(command, _is_progress_shard(safe_store, command))
+    safe_store.notify_listeners(command)
+
+
+# ---------------------------------------------------------------------------
+# Apply (Commands.java:462)
+# ---------------------------------------------------------------------------
+
+def apply_(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
+           execute_at: Timestamp, partial_deps: Optional[Deps],
+           partial_txn: Optional[PartialTxn], writes: Optional[Writes], result) -> CommitOutcome:
+    command = safe_store.get_or_create(txn_id)
+    if command.save_status.is_truncated or command.save_status is SaveStatus.INVALIDATED:
+        return CommitOutcome.REDUNDANT
+    if command.has_been(Status.PRE_APPLIED):
+        _check_consistent_execute_at(safe_store, command, execute_at)
+        return CommitOutcome.REDUNDANT
+
+    command.route = route if command.route is None else command.route.union(route)
+    if partial_txn is not None and command.partial_txn is None:
+        command.partial_txn = partial_txn
+    if partial_deps is not None and command.partial_deps is None:
+        command.partial_deps = partial_deps
+    if command.partial_deps is None:
+        return CommitOutcome.INSUFFICIENT
+    command.execute_at = execute_at
+    command.writes = writes
+    command.result = result
+    if command.waiting_on is None:
+        initialise_waiting_on(safe_store, command)
+    command.set_save_status(SaveStatus.PRE_APPLIED)
+    safe_store.register_witness(command, InternalStatus.COMMITTED)
+    maybe_execute(safe_store, command, always_notify_listeners=True)
+    return CommitOutcome.SUCCESS
+
+
+# ---------------------------------------------------------------------------
+# Execution frontier (Commands.java:617-804)
+# ---------------------------------------------------------------------------
+
+def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> None:
+    """Build the WaitingOn frontier from partial_deps (Commands.java:688):
+    include every dep not yet locally applied/invalidated whose executeAt is (or may
+    yet be) before ours; register as listener on each."""
+    if command.waiting_on is not None:
+        return
+    execute_at = command.execute_at
+    waiting = set()
+    local_ranges = safe_store.store.all_ranges()
+    deps = command.partial_deps.slice(local_ranges) if command.partial_deps is not None else Deps.NONE
+    for dep_id in deps.txn_ids():
+        if dep_id == command.txn_id:
+            continue
+        if _still_blocks(safe_store, command, dep_id, execute_at):
+            waiting.add(dep_id)
+            dep = safe_store.get_or_create(dep_id)
+            dep.listeners.add(command.txn_id)
+    command.waiting_on = WaitingOn(waiting)
+
+
+def _still_blocks(safe_store: SafeCommandStore, command: Command, dep_id: TxnId,
+                  execute_at: Timestamp) -> bool:
+    dep = safe_store.get_if_exists(dep_id)
+    if dep is None:
+        return True  # unwitnessed: must wait for it to commit locally
+    if dep.save_status in (SaveStatus.APPLIED, SaveStatus.INVALIDATED) \
+            or dep.save_status.is_truncated:
+        return False
+    if dep.has_been(Status.PRE_COMMITTED) and not command.txn_id.awaits_only_deps \
+            and dep.execute_at is not None and dep.execute_at > execute_at:
+        return False  # dep executes after us
+    return True
+
+
+def update_dependency_and_maybe_execute(safe_store: SafeCommandStore, waiter: Command,
+                                        dep: Command) -> None:
+    """Called when ``dep`` changes status and ``waiter`` is listening
+    (Commands.java:777)."""
+    if waiter.waiting_on is None or not waiter.waiting_on.is_waiting_on(dep.txn_id):
+        return
+    if not _still_blocks(safe_store, waiter, dep.txn_id, waiter.execute_at):
+        applied = dep.save_status is SaveStatus.APPLIED or dep.save_status.is_truncated
+        waiter.waiting_on.remove(dep.txn_id, applied)
+        dep.listeners.discard(waiter.txn_id)
+        maybe_execute(safe_store, waiter, always_notify_listeners=False)
+
+
+def maybe_execute(safe_store: SafeCommandStore, command: Command,
+                  always_notify_listeners: bool) -> bool:
+    """Fire ReadyToExecute / Applying when the frontier drains (Commands.java:617)."""
+    if command.save_status not in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
+        if always_notify_listeners:
+            safe_store.notify_listeners(command)
+        return False
+    if command.waiting_on is not None and command.waiting_on.is_waiting():
+        if always_notify_listeners:
+            safe_store.notify_listeners(command)
+        safe_store.progress_log().waiting(
+            next(iter(command.waiting_on.waiting)), None, command.route, None)
+        return False
+
+    if command.save_status is SaveStatus.STABLE:
+        command.set_save_status(SaveStatus.READY_TO_EXECUTE)
+        safe_store.progress_log().ready_to_execute(command)
+        safe_store.notify_listeners(command)
+        return True
+
+    # PRE_APPLIED -> Applying -> Applied
+    command.set_save_status(SaveStatus.APPLYING)
+    _apply_writes(safe_store, command)
+    return True
+
+
+def _apply_writes(safe_store: SafeCommandStore, command: Command) -> None:
+    """writes.apply + postApply (Commands.java:587-597)."""
+    ranges = safe_store.store.all_ranges()
+    t0 = safe_store.time().now_micros()
+
+    def post_apply(_=None, failure=None):
+        if failure is not None:
+            safe_store.agent().on_uncaught_exception(failure)
+            return
+        command.set_save_status(SaveStatus.APPLIED)
+        safe_store.register_witness(command, InternalStatus.APPLIED)
+        safe_store.progress_log().executed(command, _is_progress_shard(safe_store, command))
+        agent = safe_store.agent()
+        agent.metrics_events_listener().on_applied(command, t0)
+        safe_store.notify_listeners(command)
+
+    if command.writes is None or command.writes.is_empty():
+        post_apply()
+    else:
+        command.writes.apply_to(safe_store, ranges).begin(post_apply)
+
+
+# ---------------------------------------------------------------------------
+# Durability (Commands.java:927)
+# ---------------------------------------------------------------------------
+
+def set_durability(safe_store: SafeCommandStore, txn_id: TxnId, durability: Durability,
+                   route: Optional[Route] = None,
+                   execute_at: Optional[Timestamp] = None) -> Command:
+    command = safe_store.get_or_create(txn_id)
+    if route is not None and command.route is None:
+        command.route = route
+    if execute_at is not None and not command.has_been(Status.PRE_COMMITTED):
+        command.execute_at = execute_at
+    if durability > command.durability:
+        command.durability = durability
+        safe_store.progress_log().durable(command)
+    return command
+
+
+# ---------------------------------------------------------------------------
+
+def _check_consistent_execute_at(safe_store: SafeCommandStore, command: Command,
+                                 execute_at: Timestamp) -> None:
+    if command.execute_at is not None and execute_at is not None \
+            and command.has_been(Status.PRE_COMMITTED) and command.execute_at != execute_at:
+        safe_store.agent().on_inconsistent_timestamp(command, command.execute_at, execute_at)
+
+
+def _is_progress_shard(safe_store: SafeCommandStore, command: Command) -> bool:
+    """Is this store the home (progress) shard for the txn?"""
+    return (command.route is not None
+            and safe_store.store.current_ranges().contains(command.route.home_key))

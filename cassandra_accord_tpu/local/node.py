@@ -1,0 +1,187 @@
+"""The per-process facade: clock, coordination entry, message dispatch.
+
+Capability parity with ``accord.local.Node`` (Node.java:100-775): owns the node id,
+the CAS hybrid-logical clock (``unique_now``), the TopologyManager, the CommandStores,
+and the send/receive plumbing.  ``coordinate(txn)`` is the client entry point
+(Node.java:573); ``receive(request, from, reply_ctx)`` the server entry point
+(Node.java:705) with its wait-for-epoch gate.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional
+
+from ..api.interfaces import (Agent, ConfigurationService, DataStore, MessageSink,
+                              ProgressLog, Scheduler)
+from ..primitives.keys import Keys, Ranges, RoutingKey
+from ..primitives.route import Route
+from ..primitives.timestamp import Ballot, Domain, Timestamp, TxnId, TxnKind
+from ..primitives.txn import Txn
+from ..utils import async_ as au
+from ..utils.invariants import check_state
+from ..utils.random import RandomSource
+from ..topology.manager import EpochReady, TopologyManager
+from .command_store import AgentExecutor, CommandStores, SafeCommandStore
+
+if TYPE_CHECKING:
+    from ..messages.base import Callback, Reply, Request
+    from ..topology.topology import Topologies, Topology
+
+
+class Node(ConfigurationService.Listener):
+    def __init__(self, node_id: int, message_sink: MessageSink,
+                 config_service: ConfigurationService, agent: Agent,
+                 scheduler: Scheduler, data_store: DataStore,
+                 random: RandomSource, now_micros: Callable[[], int],
+                 num_shards: int = 1,
+                 executor_factory: Optional[Callable[[int], AgentExecutor]] = None,
+                 progress_log_factory: Optional[Callable[[object], ProgressLog]] = None):
+        self.id = node_id
+        self.message_sink = message_sink
+        self.config_service = config_service
+        self.agent = agent
+        self.scheduler = scheduler
+        self.data_store = data_store
+        self.random = random
+        self._now_micros = now_micros
+        self.topology = TopologyManager(node_id)
+        self.command_stores = CommandStores(self, num_shards, executor_factory)
+        self._progress_log_factory = progress_log_factory
+        self._last_hlc = 0
+        config_service.register_listener(self)
+        topo = config_service.current_topology()
+        if topo is not None and topo.size > 0:
+            self.on_topology_update(topo, start_sync=True)
+
+    # -- time (Node.java:335-360) -------------------------------------------
+    def now_micros(self) -> int:
+        return self._now_micros()
+
+    def unique_now(self) -> Timestamp:
+        hlc = max(self._now_micros(), self._last_hlc + 1)
+        self._last_hlc = hlc
+        return Timestamp(self.epoch(), hlc, self.id)
+
+    def unique_now_at_least(self, at_least: Timestamp) -> Timestamp:
+        hlc = max(self._now_micros(), self._last_hlc + 1, at_least.hlc + 1)
+        self._last_hlc = hlc
+        epoch = max(self.epoch(), at_least.epoch)
+        return Timestamp(epoch, hlc, self.id)
+
+    def epoch(self) -> int:
+        return self.topology.current_epoch
+
+    def next_txn_id(self, kind: TxnKind, domain: Domain) -> TxnId:
+        ts = self.unique_now()
+        return TxnId(ts.epoch, ts.hlc, self.id, kind, domain)
+
+    def ballot_after(self, after: Optional[Ballot]) -> Ballot:
+        ts = self.unique_now() if after is None else self.unique_now_at_least(after)
+        return Ballot.from_timestamp(ts)
+
+    # -- topology (Node.java:249, ConfigurationService.Listener) --------------
+    def on_topology_update(self, topology: "Topology", start_sync: bool) -> au.AsyncResult:
+        if self.topology.current_epoch >= topology.epoch and self.topology.current_epoch > 0:
+            return au.success_result()
+        ready = self.topology.on_topology_update(topology)
+        self.command_stores.update_topology(topology)
+        if self._progress_log_factory is not None:
+            for store in self.command_stores.all_stores():
+                if isinstance(store.progress_log, type(ProgressLog.NOOP)):
+                    store.progress_log = self._progress_log_factory(store)
+        self.config_service.acknowledge_epoch(ready, start_sync)
+        return au.success_result()
+
+    def on_remote_sync_complete(self, node_id: int, epoch: int) -> None:
+        self.topology.on_remote_sync_complete(node_id, epoch)
+
+    def on_epoch_closed(self, ranges: Ranges, epoch: int) -> None:
+        self.topology.on_epoch_closed(ranges, epoch)
+
+    def on_epoch_redundant(self, ranges: Ranges, epoch: int) -> None:
+        self.topology.on_epoch_redundant(ranges, epoch)
+
+    def truncate_topology_until(self, epoch: int) -> None:
+        self.topology.truncate_until(epoch)
+
+    def with_epoch(self, epoch: int) -> au.AsyncChain:
+        """Await local knowledge of ``epoch`` (Node.java:289-322)."""
+        if self.topology.has_epoch(epoch):
+            return au.done(None)
+        self.config_service.fetch_topology_for_epoch(epoch)
+        return self.topology.await_epoch(epoch).to_chain()
+
+    # -- coordination entry points (Node.java:573+) ---------------------------
+    def coordinate(self, txn: Txn, txn_id: Optional[TxnId] = None) -> au.AsyncResult:
+        from ..coordinate.coordinate_transaction import coordinate_transaction
+        if txn_id is None:
+            txn_id = self.next_txn_id(txn.kind, txn.domain)
+        result = au.settable()
+        self.with_epoch(txn_id.epoch).begin(
+            lambda _v, f: result.set_failure(f) if f is not None
+            else coordinate_transaction(self, txn_id, txn, result))
+        return result
+
+    def recover(self, txn_id: TxnId, route: Route) -> au.AsyncResult:
+        from ..coordinate.recover import recover as do_recover
+        result = au.settable()
+        self.with_epoch(txn_id.epoch).begin(
+            lambda _v, f: result.set_failure(f) if f is not None
+            else do_recover(self, txn_id, route, result))
+        return result
+
+    # -- message dispatch (Node.java:705, :425-527) ---------------------------
+    def receive(self, request: "Request", from_node: int, reply_context) -> None:
+        wait_for = request.wait_for_epoch()
+        if wait_for > 0 and not self.topology.has_epoch(wait_for):
+            self.with_epoch(wait_for).begin(
+                lambda _v, f: self._process_or_fail(request, from_node, reply_context, f))
+            return
+        self._process_or_fail(request, from_node, reply_context, None)
+
+    def _process_or_fail(self, request: "Request", from_node: int, reply_context,
+                         failure: Optional[BaseException]) -> None:
+        if failure is not None:
+            self.agent.on_handled_exception(failure)
+            self.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
+            return
+        try:
+            request.process(self, from_node, reply_context)
+        except BaseException as e:  # noqa: BLE001 — must reply so the caller unblocks
+            self.agent.on_handled_exception(e)
+            self.message_sink.reply_with_unknown_failure(from_node, reply_context, e)
+
+    def send(self, to: int, request: "Request", callback: Optional["Callback"] = None) -> None:
+        if callback is None:
+            self.message_sink.send(to, request)
+        else:
+            self.message_sink.send_with_callback(to, request, callback)
+
+    def send_to_each(self, nodes, request_factory: Callable[[int], Optional["Request"]],
+                     callback: Optional["Callback"] = None) -> None:
+        for to in nodes:
+            request = request_factory(to)
+            if request is not None:
+                self.send(to, request, callback)
+
+    def reply(self, to: int, reply_context, reply: "Reply") -> None:
+        self.message_sink.reply(to, reply_context, reply)
+
+    # -- local map/reduce over stores (Node.java:384-422) ---------------------
+    def map_reduce_consume_local(self, unseekables, min_epoch: int, max_epoch: int,
+                                 map_fn: Callable[[SafeCommandStore], object],
+                                 reduce_fn: Callable[[object, object], object]) -> au.AsyncChain:
+        return self.command_stores.map_reduce(unseekables, min_epoch, max_epoch,
+                                              map_fn, reduce_fn)
+
+    def for_each_local(self, unseekables, min_epoch: int, max_epoch: int,
+                       fn: Callable[[SafeCommandStore], None]) -> au.AsyncChain:
+        return self.command_stores.for_each(unseekables, min_epoch, max_epoch, fn)
+
+    # -- route computation (Node.java:604-624) --------------------------------
+    def compute_route(self, txn: Txn) -> Route:
+        """Pick a homeKey from the txn's footprint in the current epoch and build
+        the full route."""
+        return txn.to_route()
+
+    def __repr__(self) -> str:
+        return f"Node({self.id})"

@@ -1,0 +1,500 @@
+"""The transaction-pipeline wire messages and their replica-side handlers.
+
+Capability parity with ``accord.messages`` PreAccept/Accept/Commit/Apply/ReadData
+(PreAccept.java:37-354, Accept.java:50-296, Commit.java:61-409, Apply.java:47-246,
+ReadData.java:53-538): each Request processes itself against the receiving Node by
+map-reducing over the intersecting CommandStores, and replies exactly once.
+
+``Commit`` supports the reference's Stable+Read fusion (Commit.stableAndRead,
+Commit.java:176): a Commit carrying ``read=True`` executes the txn's read once the
+command becomes ReadyToExecute and replies ReadOk instead of a plain ack.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+from ..local import commands as C
+from ..local.cfk import InternalStatus
+from ..local.command_store import SafeCommandStore
+from ..local.status import SaveStatus, Status
+from ..primitives.deps import Deps, DepsBuilder
+from ..primitives.keys import Keys, Ranges
+from ..primitives.route import Route
+from ..primitives.timestamp import Ballot, Timestamp, TxnId
+from ..primitives.txn import PartialTxn, Writes
+from ..utils import async_ as au
+from .base import MessageType, Reply, Request, TxnRequest
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+
+# ---------------------------------------------------------------------------
+# deps calculation (PreAccept.calculatePartialDeps, PreAccept.java:245-267)
+# ---------------------------------------------------------------------------
+
+def worst_outcome(a, b):
+    """Reduce CommitOutcomes to the most severe across stores."""
+    order = [C.CommitOutcome.INSUFFICIENT, C.CommitOutcome.REJECTED_BALLOT,
+             C.CommitOutcome.REDUNDANT, C.CommitOutcome.SUCCESS]
+    return a if order.index(a) < order.index(b) else b
+
+
+def calculate_partial_deps(safe_store: SafeCommandStore, txn_id: TxnId,
+                           keys_or_ranges, before: Timestamp) -> Deps:
+    """All active conflicting txns with txnId < before, witnessed by txn_id's kind."""
+    builder = DepsBuilder()
+    keys = None if isinstance(keys_or_ranges, Ranges) else keys_or_ranges
+    ranges = keys_or_ranges if isinstance(keys_or_ranges, Ranges) else None
+
+    def visit(key_or_range, dep_id: TxnId):
+        if dep_id != txn_id:
+            builder.add(key_or_range, dep_id)
+
+    safe_store.map_reduce_active(keys, ranges, before, txn_id.witnesses, visit)
+    return builder.build()
+
+
+# ---------------------------------------------------------------------------
+# replies
+# ---------------------------------------------------------------------------
+
+class SimpleOk(Reply):
+    __slots__ = ()
+
+    @property
+    def type(self):
+        return MessageType.SIMPLE_RSP
+
+    def __repr__(self):
+        return "Ok"
+
+
+SIMPLE_OK = SimpleOk()
+
+
+class PreAcceptOk(Reply):
+    __slots__ = ("txn_id", "witnessed_at", "deps")
+
+    def __init__(self, txn_id: TxnId, witnessed_at: Timestamp, deps: Deps):
+        self.txn_id = txn_id
+        self.witnessed_at = witnessed_at
+        self.deps = deps
+
+    @property
+    def type(self):
+        return MessageType.PRE_ACCEPT_RSP
+
+    @property
+    def witnessed_fast_path(self) -> bool:
+        return self.witnessed_at == self.txn_id.as_timestamp()
+
+    def __repr__(self):
+        return f"PreAcceptOk({self.txn_id!r}@{self.witnessed_at!r})"
+
+
+class PreAcceptNack(Reply):
+    __slots__ = ()
+
+    @property
+    def type(self):
+        return MessageType.PRE_ACCEPT_RSP
+
+    def __repr__(self):
+        return "PreAcceptNack"
+
+
+class AcceptOk(Reply):
+    __slots__ = ("txn_id", "deps")
+
+    def __init__(self, txn_id: TxnId, deps: Deps):
+        self.txn_id = txn_id
+        self.deps = deps
+
+    @property
+    def type(self):
+        return MessageType.ACCEPT_RSP
+
+    def __repr__(self):
+        return f"AcceptOk({self.txn_id!r})"
+
+
+class AcceptNack(Reply):
+    __slots__ = ("txn_id", "supersceded_by")
+
+    def __init__(self, txn_id: TxnId, supersceded_by: Ballot):
+        self.txn_id = txn_id
+        self.supersceded_by = supersceded_by
+
+    @property
+    def type(self):
+        return MessageType.ACCEPT_RSP
+
+    def __repr__(self):
+        return f"AcceptNack({self.supersceded_by!r})"
+
+
+class CommitOk(Reply):
+    __slots__ = ()
+
+    @property
+    def type(self):
+        return MessageType.SIMPLE_RSP
+
+    def __repr__(self):
+        return "CommitOk"
+
+
+COMMIT_OK = CommitOk()
+
+
+class CommitNack(Reply):
+    __slots__ = ("outcome",)
+
+    def __init__(self, outcome):
+        self.outcome = outcome
+
+    @property
+    def type(self):
+        return MessageType.SIMPLE_RSP
+
+    def __repr__(self):
+        return f"CommitNack({self.outcome})"
+
+
+class ReadOk(Reply):
+    __slots__ = ("unavailable", "data")
+
+    def __init__(self, data, unavailable: Optional[Ranges] = None):
+        self.data = data
+        self.unavailable = unavailable
+
+    @property
+    def type(self):
+        return MessageType.READ_RSP
+
+    def __repr__(self):
+        return f"ReadOk(unavailable={self.unavailable})"
+
+
+class ReadNack(Reply):
+    """Invalid / obsolete / redundant read (ReadData.ReadNack)."""
+    __slots__ = ("reason",)
+
+    def __init__(self, reason: str):
+        self.reason = reason
+
+    @property
+    def type(self):
+        return MessageType.READ_RSP
+
+    def __repr__(self):
+        return f"ReadNack({self.reason})"
+
+
+class ApplyOk(Reply):
+    __slots__ = ()
+
+    @property
+    def type(self):
+        return MessageType.APPLY_RSP
+
+    def __repr__(self):
+        return "ApplyOk"
+
+
+APPLY_OK = ApplyOk()
+
+
+# ---------------------------------------------------------------------------
+# PreAccept
+# ---------------------------------------------------------------------------
+
+class PreAccept(TxnRequest):
+    __slots__ = ("partial_txn", "max_epoch")
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
+                 partial_txn: PartialTxn, max_epoch: int):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.partial_txn = partial_txn
+        self.max_epoch = max_epoch
+
+    @property
+    def type(self):
+        return MessageType.PRE_ACCEPT_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id, partial_txn, scope = self.txn_id, self.partial_txn, self.scope
+
+        def map_fn(safe_store: SafeCommandStore):
+            outcome = C.preaccept(safe_store, txn_id, partial_txn, scope)
+            if outcome in (C.AcceptOutcome.REJECTED_BALLOT, C.AcceptOutcome.TRUNCATED):
+                return None
+            command = safe_store.get_if_exists(txn_id)
+            deps = calculate_partial_deps(safe_store, txn_id, partial_txn.keys,
+                                          txn_id.as_timestamp())
+            return (command.execute_at, deps)
+
+        def reduce_fn(a, b):
+            if a is None or b is None:
+                return None
+            return (a[0].merge_max(b[0]), a[1].with_merged(b[1]))
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
+            elif result is None:
+                node.reply(from_node, reply_context, PreAcceptNack())
+            else:
+                witnessed_at, deps = result
+                node.reply(from_node, reply_context, PreAcceptOk(txn_id, witnessed_at, deps))
+
+        node.map_reduce_consume_local(scope, txn_id.epoch, self.max_epoch,
+                                      map_fn, reduce_fn).begin(consume)
+
+    def __repr__(self):
+        return f"PreAccept({self.txn_id!r}, {self.scope!r})"
+
+
+# ---------------------------------------------------------------------------
+# Accept (slow path)
+# ---------------------------------------------------------------------------
+
+class Accept(TxnRequest):
+    __slots__ = ("ballot", "execute_at", "partial_deps", "keys")
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int, ballot: Ballot,
+                 execute_at: Timestamp, keys, partial_deps: Deps):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.ballot = ballot
+        self.execute_at = execute_at
+        self.keys = keys
+        self.partial_deps = partial_deps
+
+    @property
+    def type(self):
+        return MessageType.ACCEPT_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id, ballot, execute_at = self.txn_id, self.ballot, self.execute_at
+        scope, keys, partial_deps = self.scope, self.keys, self.partial_deps
+
+        def map_fn(safe_store: SafeCommandStore):
+            outcome = C.accept(safe_store, txn_id, ballot, scope, execute_at, partial_deps)
+            if outcome is C.AcceptOutcome.REJECTED_BALLOT:
+                command = safe_store.get_if_exists(txn_id)
+                return ("nack", command.promised)
+            if outcome is C.AcceptOutcome.TRUNCATED:
+                return ("nack", Ballot.MAX)
+            # collect deps newly witnessed up to executeAt (Accept.java:84-118)
+            deps = calculate_partial_deps(safe_store, txn_id, keys, execute_at)
+            return ("ok", deps)
+
+        def reduce_fn(a, b):
+            if a is None or b is None:
+                return None
+            if a[0] == "nack":
+                return a
+            if b[0] == "nack":
+                return b
+            return ("ok", a[1].with_merged(b[1]))
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
+            elif result is None or result[0] == "nack":
+                superseded = result[1] if result is not None else Ballot.MAX
+                node.reply(from_node, reply_context, AcceptNack(txn_id, superseded))
+            else:
+                node.reply(from_node, reply_context, AcceptOk(txn_id, result[1]))
+
+        node.map_reduce_consume_local(scope, min(txn_id.epoch, execute_at.epoch),
+                                      execute_at.epoch, map_fn, reduce_fn).begin(consume)
+
+    def __repr__(self):
+        return f"Accept({self.txn_id!r}@{self.execute_at!r})"
+
+
+# ---------------------------------------------------------------------------
+# Commit (slow-path commit / stable, optionally fused with the read)
+# ---------------------------------------------------------------------------
+
+class Commit(TxnRequest):
+    __slots__ = ("kind_status", "ballot", "partial_txn", "execute_at", "partial_deps",
+                 "read")
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
+                 kind_status: SaveStatus, execute_at: Timestamp,
+                 partial_txn: Optional[PartialTxn], partial_deps: Deps,
+                 read: bool = False, ballot: Ballot = Ballot.ZERO):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.kind_status = kind_status    # SaveStatus.COMMITTED or SaveStatus.STABLE
+        self.ballot = ballot
+        self.partial_txn = partial_txn
+        self.execute_at = execute_at
+        self.partial_deps = partial_deps
+        self.read = read
+
+    @property
+    def type(self):
+        return MessageType.STABLE_FAST_PATH_REQ if self.kind_status is SaveStatus.STABLE \
+            else MessageType.COMMIT_SLOW_PATH_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id = self.txn_id
+
+        def map_fn(safe_store: SafeCommandStore):
+            return C.commit(safe_store, txn_id, self.kind_status, self.ballot, self.scope,
+                            self.partial_txn, self.execute_at, self.partial_deps)
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
+                return
+            if result not in (C.CommitOutcome.SUCCESS, C.CommitOutcome.REDUNDANT):
+                node.reply(from_node, reply_context, CommitNack(result))
+                return
+            if self.read:
+                execute_read(node, from_node, reply_context, txn_id, self.scope,
+                             self.execute_at)
+            else:
+                node.reply(from_node, reply_context, COMMIT_OK)
+
+        node.map_reduce_consume_local(self.scope, txn_id.epoch, self.execute_at.epoch,
+                                      map_fn, worst_outcome).begin(consume)
+
+    def __repr__(self):
+        tag = "+read" if self.read else ""
+        return f"Commit[{self.kind_status.name}]({self.txn_id!r}{tag})"
+
+
+# ---------------------------------------------------------------------------
+# ReadData / ReadTxnData (standalone read of a committed txn)
+# ---------------------------------------------------------------------------
+
+class ReadTxnData(TxnRequest):
+    __slots__ = ("execute_at_epoch",)
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
+                 execute_at_epoch: int):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.execute_at_epoch = execute_at_epoch
+
+    @property
+    def type(self):
+        return MessageType.READ_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        execute_read(node, from_node, reply_context, self.txn_id, self.scope, None)
+
+    def __repr__(self):
+        return f"ReadTxnData({self.txn_id!r})"
+
+
+def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
+                 scope: Route, execute_at_hint: Optional[Timestamp]) -> None:
+    """Wait per-store for ReadyToExecute, run the read, merge Data, reply ReadOk
+    (ReadData.java:57-260 state machine, collapsed to the wait->execute->reply path)."""
+    stores = node.command_stores.intersecting_stores(
+        scope, txn_id.epoch,
+        execute_at_hint.epoch if execute_at_hint is not None else txn_id.epoch)
+    if not stores:
+        node.reply(from_node, reply_context, ReadNack("no intersecting store"))
+        return
+
+    chains = [store.submit(lambda s: _read_when_ready(s, txn_id)).flat_map(lambda c: c)
+              for store in stores]
+
+    def consume(datas, failure):
+        if failure is not None:
+            node.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
+            return
+        if any(d == "nack" for d in datas):
+            node.reply(from_node, reply_context, ReadNack("invalidated"))
+            return
+        merged = None
+        for d in datas:
+            if d is None:
+                continue
+            merged = d if merged is None else merged.merge(d)
+        node.reply(from_node, reply_context, ReadOk(merged))
+
+    au.all_of(chains).begin(consume)
+
+
+def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncChain:
+    """Returns a chain yielding the Data read at executeAt (or 'nack')."""
+    result = au.settable()
+    store = safe_store.store
+
+    def try_read(s: SafeCommandStore, command) -> bool:
+        if command.save_status is SaveStatus.INVALIDATED:
+            result.set_success("nack")
+            return True
+        if command.save_status.ordinal >= SaveStatus.READY_TO_EXECUTE.ordinal \
+                and not command.save_status.is_truncated:
+            ranges = s.store.current_ranges()
+            read_keys = [k for k in command.partial_txn.keys
+                         if ranges.contains(k.to_routing() if hasattr(k, "to_routing") else k)] \
+                if not isinstance(command.partial_txn.keys, Ranges) else command.partial_txn.keys
+            command.partial_txn.read_chain(s, command.execute_at, read_keys).begin(
+                lambda data, f: result.set_failure(f) if f is not None
+                else result.set_success(data))
+            return True
+        return False
+
+    command = safe_store.get_or_create(txn_id)
+    if not try_read(safe_store, command):
+        def listener(s: SafeCommandStore, cmd):
+            if try_read(s, cmd):
+                s.remove_transient_listener(txn_id, listener)
+        safe_store.add_transient_listener(txn_id, listener)
+    return result.to_chain()
+
+
+# ---------------------------------------------------------------------------
+# Apply
+# ---------------------------------------------------------------------------
+
+class Apply(TxnRequest):
+    __slots__ = ("kind", "execute_at", "partial_deps", "partial_txn", "writes", "result")
+
+    MINIMAL = "minimal"
+    MAXIMAL = "maximal"
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int, kind: str,
+                 execute_at: Timestamp, partial_deps: Deps,
+                 partial_txn: Optional[PartialTxn], writes: Optional[Writes], result):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.kind = kind
+        self.execute_at = execute_at
+        self.partial_deps = partial_deps
+        self.partial_txn = partial_txn
+        self.writes = writes
+        self.result = result
+
+    @property
+    def type(self):
+        return MessageType.APPLY_MAXIMAL_REQ if self.kind == Apply.MAXIMAL \
+            else MessageType.APPLY_MINIMAL_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id = self.txn_id
+
+        def map_fn(safe_store: SafeCommandStore):
+            return C.apply_(safe_store, txn_id, self.scope, self.execute_at,
+                            self.partial_deps, self.partial_txn, self.writes, self.result)
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
+            elif result is C.CommitOutcome.INSUFFICIENT:
+                node.reply(from_node, reply_context, ReadNack("insufficient"))
+            else:
+                node.reply(from_node, reply_context, APPLY_OK)
+
+        node.map_reduce_consume_local(self.scope, txn_id.epoch, self.execute_at.epoch,
+                                      map_fn, worst_outcome).begin(consume)
+
+    def __repr__(self):
+        return f"Apply[{self.kind}]({self.txn_id!r})"

@@ -96,7 +96,11 @@ class Txn:
         """Execute the read hook for every key in scope; merge Data."""
         chains = []
         data_store = safe_store.data_store()
+        read_keys = self.read.keys()
         for key in read_scope:
+            if read_keys is not None and not isinstance(read_keys, Ranges) \
+                    and not read_keys.contains(key):
+                continue  # only keys the Read declares (write-only keys are skipped)
             chains.append(self.read.read(key, safe_store, execute_at, data_store))
         if not chains:
             return au.done(None)

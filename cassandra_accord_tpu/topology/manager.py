@@ -160,9 +160,12 @@ class TopologyManager:
 
     # -- coordination selection (TopologyManager.java:513+) ------------------
     def precise_epochs(self, unseekables, min_epoch: int, max_epoch: int) -> Topologies:
+        """Topologies over [min_epoch, max_epoch], each trimmed to the shards
+        intersecting ``unseekables`` (a Route/RoutingKeys/Ranges, or None for all)."""
         check_argument(self.has_epoch(min_epoch) and self.has_epoch(max_epoch),
                        "epochs [%s,%s] not all known", min_epoch, max_epoch)
-        return Topologies([self.topology_for_epoch(e) for e in range(min_epoch, max_epoch + 1)])
+        return Topologies([self.topology_for_epoch(e).trim(unseekables)
+                           for e in range(min_epoch, max_epoch + 1)])
 
     def with_unsynced_epochs(self, unseekables, min_epoch: int, max_epoch: int) -> Topologies:
         """Like precise_epochs but extended down over epochs that are not yet

@@ -146,6 +146,13 @@ class Topology:
     def for_node(self, node: int) -> "Topology":
         return Topology(self.epoch, [s for s in self.shards if s.contains_node(node)])
 
+    def trim(self, unseekables) -> "Topology":
+        """Subset topology containing only shards intersecting the selection
+        (Topology.forSelection/trim semantics)."""
+        if unseekables is None:
+            return self
+        return Topology(self.epoch, self.for_selection(unseekables))
+
     def ranges_for_node(self, node: int) -> Ranges:
         return Ranges.of(*[s.range for s in self.shards if s.contains_node(node)])
 
