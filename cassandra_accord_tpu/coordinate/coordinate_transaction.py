@@ -237,7 +237,7 @@ class _ExecuteTxn:
                         this.result.set_failure(Preempted(this.txn_id, "commit"))
                     else:
                         this.result.set_failure(Insufficient(this.txn_id, str(reply.outcome)))
-                else:  # CommitOk
+                else:  # CommitOk / StableAck
                     this.on_stable_ack(from_node)
                     if not this.done:
                         this.maybe_finish()

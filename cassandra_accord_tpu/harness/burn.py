@@ -1,0 +1,143 @@
+"""The burn test: randomized workloads on the deterministic cluster, verified for
+strict serializability.
+
+Capability parity with ``accord.burn.BurnTest`` (BurnTest.java:123-622): one seed
+fully determines topology (rf, node count, key count), the randomized client workload
+(read/write/read-write txns over 1-3 keys, zipf-or-uniform key choice), concurrency
+window, link latencies and faults; every client op feeds the verifier; any violation
+or unresolved op fails the run with its seed.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..impl.list_store import ListResult, list_txn
+from ..primitives.keys import IntKey, Range
+from ..topology.topology import Shard, Topology
+from ..utils.random import RandomSource
+from .cluster import Cluster, LinkConfig
+from .verifier import HistoryViolation, Observation, StrictSerializabilityVerifier
+
+
+class BurnResult:
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.ops_submitted = 0
+        self.ops_ok = 0
+        self.ops_failed = 0
+        self.sim_micros = 0
+        self.stats: Dict[str, int] = {}
+
+    def __repr__(self):
+        return (f"BurnResult(seed={self.seed}, ok={self.ops_ok}, "
+                f"failed={self.ops_failed}, sim_ms={self.sim_micros // 1000})")
+
+
+class SimulationException(Exception):
+    """Wraps any failure with its seed so the run can be replayed
+    (BurnTest.java:588)."""
+
+    def __init__(self, seed: int, cause: BaseException):
+        super().__init__(f"burn seed={seed} failed: {cause}")
+        self.seed = seed
+        self.cause = cause
+
+
+def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
+             link_config: Optional[LinkConfig] = None,
+             nodes: Optional[int] = None, rf: Optional[int] = None,
+             key_count: Optional[int] = None, num_shards: int = 1,
+             allow_failures: bool = False) -> BurnResult:
+    """Run one seeded burn; raises SimulationException on any violation."""
+    rng = RandomSource(seed)
+    rf = rf if rf is not None else rng.pick([3, 3, 5])
+    n_nodes = nodes if nodes is not None else rng.next_int(rf, 2 * rf)
+    key_count = key_count if key_count is not None else rng.next_int(5, 21)
+    node_ids = list(range(1, n_nodes + 1))
+
+    # shard the key space into rf-replicated ranges over the nodes
+    n_ranges = max(1, n_nodes // max(1, rf // 2))
+    bound = 1000
+    step = bound // n_ranges
+    shards = []
+    for i in range(n_ranges):
+        start, end = i * step, bound if i == n_ranges - 1 else (i + 1) * step
+        replicas = [node_ids[(i + j) % n_nodes] for j in range(rf)]
+        shards.append(Shard(Range(IntKey(start), IntKey(end)), replicas))
+    topology = Topology(1, shards)
+
+    cluster = Cluster(topology, seed=rng.next_long(), num_shards=num_shards,
+                      link_config=link_config)
+    member_ids = sorted(cluster.nodes)  # nodes actually replicating some shard
+    verifier = StrictSerializabilityVerifier()
+    result = BurnResult(seed)
+    zipf = rng.next_boolean()
+
+    def key_for(i: int) -> IntKey:
+        idx = rng.next_zipf(key_count) if zipf else rng.next_int(key_count)
+        return IntKey((idx * bound) // key_count)
+
+    state = {"submitted": 0, "in_flight": 0}
+
+    def submit_next() -> None:
+        while state["in_flight"] < concurrency and state["submitted"] < ops:
+            op_id = state["submitted"]
+            state["submitted"] += 1
+            state["in_flight"] += 1
+            nkeys = rng.next_int(1, 4)
+            keys = sorted({key_for(i) for i in range(nkeys)})
+            kind = rng.pick(["read", "write", "rw", "rw"])
+            reads = keys if kind in ("read", "rw") else []
+            writes = {key: f"v{op_id}.{ki}" for ki, key in enumerate(keys)} \
+                if kind in ("write", "rw") else {}
+            txn = list_txn(reads, writes)
+            coordinator = cluster.nodes[rng.pick(member_ids)]
+            obs = verifier.begin(cluster.now_micros)
+
+            def on_done(value, failure, obs=obs, writes=writes):
+                state["in_flight"] -= 1
+                if failure is not None or not isinstance(value, ListResult):
+                    obs.fail(cluster.now_micros)
+                    result.ops_failed += 1
+                else:
+                    obs.complete(cluster.now_micros,
+                                 dict(value.reads), dict(writes))
+                    result.ops_ok += 1
+                submit_next()
+
+            coordinator.coordinate(txn).add_listener(on_done)
+    submit_next()
+
+    try:
+        cluster.run_until(lambda: result.ops_ok + result.ops_failed >= ops,
+                          max_tasks=5_000_000)
+        cluster.run_until_idle(max_tasks=5_000_000)
+        result.ops_submitted = state["submitted"]
+        result.sim_micros = cluster.now_micros
+        result.stats = dict(cluster.stats)
+        if result.ops_ok + result.ops_failed < ops:
+            raise HistoryViolation(
+                f"only {result.ops_ok + result.ops_failed}/{ops} ops resolved "
+                f"(liveness stall)")
+        if not allow_failures and result.ops_failed:
+            raise HistoryViolation(f"{result.ops_failed} ops failed under a benign network")
+        # final replica state must agree per key across replicas covering it
+        final: Dict[IntKey, tuple] = {}
+        for shard in topology.shards:
+            lists = {}
+            for n in shard.nodes:
+                store = cluster.stores[n]
+                for key, entries in store.data.items():
+                    if shard.range.contains(key):
+                        lists.setdefault(key, set()).add(tuple(v for _, v in entries))
+            for key, variants in lists.items():
+                longest = max(variants, key=len)
+                for v in variants:
+                    if v != longest[:len(v)]:
+                        raise HistoryViolation(
+                            f"replica divergence on {key}: {sorted(variants)}")
+                final[key] = longest
+        verifier.verify(final)
+    except BaseException as e:  # noqa: BLE001
+        raise SimulationException(seed, e) from e
+    return result

@@ -158,10 +158,17 @@ class SimMessageSink(MessageSink):
         entry = self.callbacks.get(msg_id)
         if entry is None:
             return
-        callback, timeout_entry, _to = entry
+        callback, timeout_entry, to = entry
+        timeout_entry.cancel()
         if reply.is_final:
             del self.callbacks[msg_id]
-            timeout_entry.cancel()
+        else:
+            # non-final reply (e.g. StableAck before a long dependency wait):
+            # keep the callback registered and re-arm the timeout so a lost final
+            # reply still triggers the failure/retry path
+            timeout_us = int(self.cluster.reply_timeout_s * 1_000_000)
+            new_entry = self.cluster.queue.add_after(timeout_us, lambda: self._timeout(msg_id))
+            self.callbacks[msg_id] = (callback, new_entry, to)
         try:
             if isinstance(reply, FailureReply):
                 callback.on_failure(from_node, reply.failure)

@@ -1,0 +1,149 @@
+"""Strict-serializability verification for the list-append workload.
+
+Capability parity with ``accord.verify.StrictSerializabilityVerifier``
+(verify/StrictSerializabilityVerifier.java:40-894): client-visible observations
+(what each txn read per key, what it appended, and its real-time submit/complete
+window) are checked for the three properties that pin down strict serializability in
+the unique-value list-append model:
+
+1. **per-key linearizability**: every observed list for a key must be a prefix of a
+   single total per-key order (the applied order);
+2. **real-time order**: a txn that completed before another was submitted must be
+   visible to it (reads include its writes; writes precede its writes);
+3. **atomicity (no fractured reads)**: if any of txn W's writes is visible to reader
+   R, every W write on a key R read must be visible to R.
+
+Any violation raises ``HistoryViolation`` naming the offending txns, like the
+reference's seed-stamped failures.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..primitives.keys import Key
+
+
+class HistoryViolation(AssertionError):
+    pass
+
+
+class Observation:
+    """One client txn's visible behavior."""
+
+    __slots__ = ("op_id", "submit_time", "complete_time", "reads", "writes", "failed")
+
+    def __init__(self, op_id: int, submit_time: int):
+        self.op_id = op_id
+        self.submit_time = submit_time
+        self.complete_time: Optional[int] = None
+        self.reads: Dict[Key, Tuple] = {}       # key -> observed list
+        self.writes: Dict[Key, object] = {}     # key -> unique appended value
+        self.failed = False
+
+    def complete(self, complete_time: int, reads: Dict[Key, Tuple],
+                 writes: Dict[Key, object]) -> None:
+        self.complete_time = complete_time
+        self.reads = reads
+        self.writes = writes
+
+    def fail(self, complete_time: int) -> None:
+        self.complete_time = complete_time
+        self.failed = True
+
+
+class StrictSerializabilityVerifier:
+    def __init__(self):
+        self.observations: List[Observation] = []
+        self._next_op = 0
+
+    def begin(self, submit_time: int) -> Observation:
+        obs = Observation(self._next_op, submit_time)
+        self._next_op += 1
+        self.observations.append(obs)
+        return obs
+
+    # ------------------------------------------------------------------
+    def verify(self, final_state: Optional[Dict[Key, Tuple]] = None) -> None:
+        done = [o for o in self.observations if o.complete_time is not None and not o.failed]
+        self._check_response_accounting()
+        orders = self._check_prefix_consistency(done, final_state)
+        self._check_real_time(done, orders)
+        self._check_atomicity(done)
+
+    # -- 0: every op resolved ------------------------------------------------
+    def _check_response_accounting(self) -> None:
+        unresolved = [o.op_id for o in self.observations if o.complete_time is None]
+        if unresolved:
+            raise HistoryViolation(f"ops never resolved: {unresolved}")
+
+    # -- 1: per-key prefix order --------------------------------------------
+    def _check_prefix_consistency(self, done: List[Observation],
+                                  final_state: Optional[Dict[Key, Tuple]]
+                                  ) -> Dict[Key, Tuple]:
+        by_key: Dict[Key, List[Tuple[int, Tuple]]] = {}
+        for o in done:
+            for key, lst in o.reads.items():
+                by_key.setdefault(key, []).append((o.op_id, lst))
+        if final_state:
+            for key, lst in final_state.items():
+                by_key.setdefault(key, []).append((-1, lst))
+        orders: Dict[Key, Tuple] = {}
+        for key, views in by_key.items():
+            views.sort(key=lambda v: len(v[1]))
+            for (op_a, a), (op_b, b) in zip(views, views[1:]):
+                if a != b[:len(a)]:
+                    raise HistoryViolation(
+                        f"key {key}: op {op_a} observed {a} which is not a prefix of "
+                        f"op {op_b}'s {b}")
+            orders[key] = views[-1][1] if views else ()
+        return orders
+
+    # -- 2: real-time --------------------------------------------------------
+    def _check_real_time(self, done: List[Observation], orders: Dict[Key, Tuple]) -> None:
+        # index: for each key, value -> position in the longest observed order
+        pos: Dict[Key, Dict[object, int]] = {
+            key: {v: i for i, v in enumerate(order)} for key, order in orders.items()}
+        for a in done:
+            for b in done:
+                if a is b or a.complete_time is None or a.complete_time > b.submit_time:
+                    continue
+                # a completed strictly before b was submitted
+                for key, value in a.writes.items():
+                    if key in b.reads:
+                        if value not in b.reads[key]:
+                            raise HistoryViolation(
+                                f"real-time violation: op {a.op_id} wrote {value!r} to "
+                                f"{key} and completed at {a.complete_time}, but op "
+                                f"{b.op_id} (submitted {b.submit_time}) read {b.reads[key]}")
+                    if key in b.writes and key in pos:
+                        pa = pos[key].get(value)
+                        pb = pos[key].get(b.writes[key])
+                        if pa is not None and pb is not None and pa > pb:
+                            raise HistoryViolation(
+                                f"real-time violation: op {a.op_id}'s write {value!r} "
+                                f"ordered after op {b.op_id}'s {b.writes[key]!r} on {key} "
+                                f"despite completing before it was submitted")
+
+    # -- 3: atomicity --------------------------------------------------------
+    def _check_atomicity(self, done: List[Observation]) -> None:
+        writers: Dict[object, Observation] = {}
+        for o in done:
+            for key, value in o.writes.items():
+                writers[(key, value)] = o
+        for reader in done:
+            if not reader.reads:
+                continue
+            # visibility of each writer txn to this reader, per shared key
+            seen: Dict[int, List[Tuple[Key, bool]]] = {}
+            for key, lst in reader.reads.items():
+                observed = set(lst)
+                for (wkey, value), writer in writers.items():
+                    if wkey != key or writer is reader:
+                        continue
+                    seen.setdefault(writer.op_id, []).append((key, value in observed))
+            for writer_id, flags in seen.items():
+                states = {f for _, f in flags}
+                if len(states) > 1:
+                    raise HistoryViolation(
+                        f"fractured read: op {reader.op_id} sees only part of op "
+                        f"{writer_id}'s writes: {flags}")

@@ -286,11 +286,18 @@ def maybe_execute(safe_store: SafeCommandStore, command: Command,
             safe_store.notify_listeners(command)
         return False
     if command.waiting_on is not None and command.waiting_on.is_waiting():
+        # capture the blocking dep BEFORE notifying: notification can re-enter
+        # this command (a dependent applies, notifying its listeners, which may
+        # include us) and drain waiting_on under our feet
+        blocking = next(iter(command.waiting_on.waiting))
         if always_notify_listeners:
             safe_store.notify_listeners(command)
-        safe_store.progress_log().waiting(
-            next(iter(command.waiting_on.waiting)), None, command.route, None)
-        return False
+            if command.save_status not in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
+                return False  # re-entrant notification already advanced us
+        if command.waiting_on.is_waiting():
+            safe_store.progress_log().waiting(blocking, None, command.route, None)
+            return False
+        # frontier drained during notification but no one executed us: fall through
 
     if command.save_status is SaveStatus.STABLE:
         command.set_save_status(SaveStatus.READY_TO_EXECUTE)

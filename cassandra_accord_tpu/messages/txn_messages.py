@@ -148,6 +148,27 @@ class CommitOk(Reply):
 COMMIT_OK = CommitOk()
 
 
+class StableAck(Reply):
+    """Immediate non-final ack of the Stable state for a Commit that also carries a
+    read: the stable quorum must not wait on read execution (the read legitimately
+    blocks on dependencies). The final ReadOk follows on the same correlation id."""
+    __slots__ = ()
+
+    @property
+    def type(self):
+        return MessageType.SIMPLE_RSP
+
+    @property
+    def is_final(self):
+        return False
+
+    def __repr__(self):
+        return "StableAck"
+
+
+STABLE_ACK = StableAck()
+
+
 class CommitNack(Reply):
     __slots__ = ("outcome",)
 
@@ -355,6 +376,7 @@ class Commit(TxnRequest):
                 node.reply(from_node, reply_context, CommitNack(result))
                 return
             if self.read:
+                node.reply(from_node, reply_context, STABLE_ACK)
                 execute_read(node, from_node, reply_context, txn_id, self.scope,
                              self.execute_at)
             else:
