@@ -1,0 +1,22 @@
+"""TPU data plane: device-resident conflict graphs + batched deps kernels.
+
+Timestamps cross the host<->device boundary as five non-negative int32 lanes
+(Timestamp.pack_lanes) whose lexicographic order equals the host total order,
+so the device plane needs no x64 mode and importing this package has no
+global JAX-config side effects.
+"""
+from .graph_state import (
+    GraphState, init_state, insert_batch, set_status_batch,
+    set_execute_at_batch, evict_mask, ts_less, to_host_deps, TS_LANES,
+)
+from .deps_kernels import (
+    overlap_join, max_conflict_ts, transitive_closure, elide,
+    kahn_frontier, kahn_levels, scc_condense,
+)
+
+__all__ = [
+    "GraphState", "init_state", "insert_batch", "set_status_batch",
+    "set_execute_at_batch", "evict_mask", "ts_less", "to_host_deps", "TS_LANES",
+    "overlap_join", "max_conflict_ts", "transitive_closure", "elide",
+    "kahn_frontier", "kahn_levels", "scc_condense",
+]

@@ -1,0 +1,256 @@
+"""Batched dependency-graph kernels: overlap join, closure, elision, Kahn, SCC.
+
+These are the TPU data-plane replacements for the reference's hot loops:
+
+- ``overlap_join``        <- ``CommandsForKey.mapReduceActive`` per-key scans
+                            (cfk/CommandsForKey.java:925-1000) + KeyDeps builder
+                            merges (KeyDeps.java:110-148).  One bf16 matmul on
+                            the MXU computes the whole PreAccept batch's
+                            conflicts against every in-flight txn at once,
+                            with the Txn.Kind witness matrix (Txn.java:221-262)
+                            and started-before predicate fused as masks.
+- ``transitive_closure``  <- the implicit transitive reachability the reference
+                            maintains via deps-by-omission/elision
+                            (CommandsForKey.java:101-157).  log2(T) boolean
+                            matrix squarings.
+- ``elide``               <- transitive dependency elision (doc :144-157):
+                            drop edge i->j when a longer path i->..->j exists.
+- ``kahn_frontier``/``kahn_levels`` <- the WaitingOn execution frontier
+                            (Command.java:1225-1320, Commands.maybeExecute
+                            Commands.java:617): which txns have all deps
+                            applied and may execute now, and the full
+                            topological schedule.
+- ``scc_condense``        <- cycle handling: Accord's deps graph may contain
+                            cycles (the decided executeAt breaks them at
+                            execution time, Commands.java:707-775); SCC
+                            membership via forward&backward reachability lets
+                            a batch executor order a cycle-heavy graph by
+                            (condensed topo level, executeAt).
+
+Everything is static-shape, jit-safe, and deterministic.  Matmuls are bf16 on
+the MXU with f32 accumulation; inputs are 0/1 and only zero/nonzero of the
+product is consumed, so results are exact.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .graph_state import ts_less, STABLE, APPLIED, INVALIDATED
+
+
+def _witness_table() -> np.ndarray:
+    """WITNESSES[a, b] = does a txn of kind-code a depend on conflicting txns
+    of kind-code b (Txn.Kind.witnesses, Txn.java:221-262).  Built from the
+    host enum so device and control plane can never disagree."""
+    from ..primitives.timestamp import TxnKind
+    n = len(TxnKind)
+    w = np.zeros((n, n), dtype=np.bool_)
+    for a in TxnKind:
+        for b in TxnKind:
+            w[a, b] = a.witnesses(b)
+    return w
+
+
+WITNESSES = jnp.asarray(_witness_table())
+
+
+def _bool_matmul(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Boolean matrix product (a @ b) > 0 via bf16 MXU matmul."""
+    p = jax.lax.dot_general(
+        a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+        dimension_numbers=(((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    return p > 0.0
+
+
+# ---------------------------------------------------------------------------
+# Overlap join — the PreAccept/Accept dependency calculation
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def overlap_join(index_key_inc: jax.Array,   # [T, K] int8 — in-flight txns
+                 index_txn_id: jax.Array,    # [T, 5] int32 — their TxnIds
+                 index_kind: jax.Array,      # [T] int8
+                 index_status: jax.Array,    # [T] int8
+                 index_active: jax.Array,    # [T] bool
+                 batch_key_inc: jax.Array,   # [B, K] int8 — new txns' keys
+                 batch_txn_id: jax.Array,    # [B, 5] int32
+                 batch_kind: jax.Array,      # [B] int8
+                 ) -> jax.Array:
+    """For each of B new transactions, the set of in-flight txns it must
+    depend on: shares >=1 key, witness-matrix hit, active, not invalidated,
+    and STARTED BEFORE in TxnId order (mapReduceActive's
+    TestStartedAt.STARTED_BEFORE, SafeCommandStore.java:65-72).
+
+    Returns deps: [B, T] bool."""
+    share_key = _bool_matmul(batch_key_inc, index_key_inc.T)             # [B, T]
+    started_before = ts_less(index_txn_id[None, :, :],
+                             batch_txn_id[:, None, :])                   # [B, T]
+    witnesses = WITNESSES[batch_kind[:, None].astype(jnp.int32),
+                          index_kind[None, :].astype(jnp.int32)]         # [B, T]
+    eligible = index_active & (index_status != INVALIDATED)              # [T]
+    return share_key & started_before & witnesses & eligible[None, :]
+
+
+def _lex_max_masked(vals: jax.Array, mask: jax.Array) -> jax.Array:
+    """Lexicographic max of packed timestamps vals[B, T, L] over axis 1,
+    considering only entries where mask[B, T]; fully-masked rows yield zero
+    lanes (= Timestamp.NONE — all real lanes are >= 0)."""
+    lanes = vals.shape[-1]
+    tie = mask
+    out = []
+    for lane in range(lanes):
+        m = jnp.where(tie, vals[..., lane], -1)
+        best = jnp.max(m, axis=1)                      # [B]
+        tie = tie & (vals[..., lane] == best[:, None])
+        out.append(jnp.maximum(best, 0))
+    return jnp.stack(out, axis=-1)                     # [B, L]
+
+
+@jax.jit
+def max_conflict_ts(index_exec_at: jax.Array,  # [T, 5] int32
+                    deps: jax.Array,           # [B, T] bool
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Per new txn, the lexicographic max executeAt over its conflict set —
+    the ``maxConflicts`` input to the replica-side timestamp proposal
+    (Commands.preaccept / PreAccept.java:245-267, MaxConflicts.java:32).
+
+    The proposal itself (txnId if maxConflict < txnId, else
+    unique_now_at_least(maxConflict)) stays HOST-side: the HLC register that
+    uniquifies proposals is host clock state (Node.unique_now_at_least,
+    local/node.py), so the device reports the max and the control plane
+    finalises — keeping device results bit-identical to the host resolver.
+
+    Returns (conflict_max [B, 5] int32, any_dep [B] bool)."""
+    conflict_max = _lex_max_masked(
+        jnp.broadcast_to(index_exec_at[None, :, :],
+                         deps.shape + (index_exec_at.shape[-1],)), deps)
+    return conflict_max, jnp.any(deps, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Transitive closure / elision
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def transitive_closure(adj: jax.Array) -> jax.Array:
+    """Reachability closure of a [T, T] bool adjacency by repeated squaring:
+    R_{k+1} = R_k | R_k @ R_k, log2(T)+1 iterations, one MXU matmul each."""
+    t = adj.shape[0]
+    iters = max(1, int(t - 1).bit_length())
+    reach = adj.astype(jnp.bool_)
+
+    def body(_, r):
+        return r | _bool_matmul(r, r)
+
+    return jax.lax.fori_loop(0, iters, body, reach)
+
+
+@jax.jit
+def elide(adj: jax.Array) -> jax.Array:
+    """Transitive reduction on DAG edges: drop i->j if a path i->k->..->j
+    exists.  Mirrors the reference's dependency elision
+    (CommandsForKey.java:144-157) — a dependency already implied transitively
+    need not be tracked.  Edges inside a cycle are kept (reduction is only
+    unique on the condensation)."""
+    a = adj.astype(jnp.bool_)
+    reach = transitive_closure(a)
+    implied = _bool_matmul(a, reach)   # path of length >= 2
+    in_cycle = reach & reach.T
+    return a & (~implied | in_cycle)
+
+
+# ---------------------------------------------------------------------------
+# Execution frontier (Kahn) and full schedule
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def kahn_frontier(adj: jax.Array, status: jax.Array,
+                  active: jax.Array) -> jax.Array:
+    """Which txns are ready to execute NOW: stable, active, and every
+    dependency already applied/invalidated/evicted (Commands.maybeExecute,
+    Commands.java:617-652: Stable + !isWaiting -> ReadyToExecute).
+    Returns [T] bool."""
+    dep_done = (status == APPLIED) | (status == INVALIDATED) | ~active
+    waiting = _bool_matmul(adj, (~dep_done)[:, None].astype(jnp.int8))[:, 0]
+    return active & (status == STABLE) & ~waiting
+
+
+@jax.jit
+def kahn_levels(adj: jax.Array, active: jax.Array) -> jax.Array:
+    """Full topological schedule: level[i] = longest dependency chain below i;
+    executing levels in order respects every edge.  While-loop peeling
+    zero-indegree txns, one matmul per level.  Cycle members never peel and
+    keep level -1 (route them through scc_condense).  Returns [T] int32."""
+    t = adj.shape[0]
+    a = adj.astype(jnp.bool_) & active[:, None] & active[None, :]
+
+    def cond(carry):
+        _, done, it = carry
+        return (it < t) & jnp.any(active & ~done)
+
+    def body(carry):
+        level, done, it = carry
+        blocked = _bool_matmul(a, (~done)[:, None].astype(jnp.int8))[:, 0]
+        newly = active & ~done & ~blocked
+        progressed = jnp.any(newly)
+        level = jnp.where(newly, it, level)
+        done = done | newly
+        it = jnp.where(progressed, it + 1, t)   # no progress => cycle: stop
+        return level, done, it
+
+    level0 = jnp.full((t,), -1, dtype=jnp.int32)
+    level, _, _ = jax.lax.while_loop(cond, body, (level0, ~active, jnp.int32(0)))
+    return level
+
+
+# ---------------------------------------------------------------------------
+# SCC condensation (cycle-heavy adversarial graphs, BASELINE config 5)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def scc_condense(adj: jax.Array, active: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Strongly-connected-component labels via matmul reachability:
+    i ~ j iff reach[i,j] & reach[j,i].  Label = smallest member slot.
+
+    Returns (labels [T] int32, level [T] int32): level is a topological level
+    over the condensation, shared by all members of an SCC — combined with
+    executeAt order inside the component this yields a total execution order
+    even for cyclic dependency graphs."""
+    t = adj.shape[0]
+    a = adj.astype(jnp.bool_) & active[:, None] & active[None, :]
+    reach = transitive_closure(a)
+    same = ((reach & reach.T) | jnp.eye(t, dtype=jnp.bool_))
+    same = same & active[:, None] & active[None, :]
+    idx = jnp.arange(t, dtype=jnp.int32)
+    labels = jnp.min(jnp.where(same, idx[None, :], t), axis=1).astype(jnp.int32)
+    labels = jnp.where(active, labels, -1)
+
+    cond_edge = a & (labels[:, None] != labels[None, :])
+
+    def cond_fn(carry):
+        _, done, it = carry
+        return (it < t) & jnp.any(active & ~done)
+
+    def body_fn(carry):
+        level, done, it = carry
+        blocked = _bool_matmul(cond_edge, (~done)[:, None].astype(jnp.int8))[:, 0]
+        # whole components move together: ready iff NO undone member has a
+        # blocked cross-component dependency
+        comp_blocked = jnp.zeros((t,), dtype=jnp.int32).at[labels].max(
+            (blocked & active & ~done).astype(jnp.int32), mode="drop")
+        ready = active & ~done & (comp_blocked[labels] == 0)
+        progressed = jnp.any(ready)
+        level = jnp.where(ready, it, level)
+        done = done | ready
+        it = jnp.where(progressed, it + 1, t)
+        return level, done, it
+
+    level0 = jnp.full((t,), -1, dtype=jnp.int32)
+    level, _, _ = jax.lax.while_loop(cond_fn, body_fn,
+                                     (level0, ~active, jnp.int32(0)))
+    return labels, level

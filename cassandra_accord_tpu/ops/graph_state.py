@@ -1,0 +1,155 @@
+"""Device-resident conflict-graph state for one command-store shard.
+
+This is the TPU-native replacement for the reference's per-key CSR conflict
+indexes (``accord.local.cfk.CommandsForKey`` byId/committedByExecuteAt arrays,
+CommandsForKey.java:615-628, and ``accord.primitives.KeyDeps`` CSR maps,
+KeyDeps.java:150-187).  Instead of pointer-chasing sorted arrays per key, a
+shard keeps ONE fixed-shape pytree of device arrays covering every in-flight
+transaction it manages:
+
+- ``key_inc``   [T, K]  key-incidence matrix (txn slot x key slot), int8 on
+                        host, cast to bf16 on the MXU path.  Key slots are
+                        assigned exactly (host-side dict key->slot), never
+                        hashed, so the computed dependency graph is bit-exact
+                        with the reference resolver ("deps-graph parity").
+- ``ts``        [T, 5]  execute-at/witnessed-at timestamp per slot: int32
+                        lanes (epoch, hlc>>31, hlc&0x7FFFFFFF, flags, node)
+                        from host Timestamp.pack_lanes().  Lexicographic over
+                        the 5 lanes == host total order (epoch, hlc, flags,
+                        node); all lanes non-negative and int32 so the device
+                        plane never needs x64 mode (bounds enforced by
+                        pack_lanes at the host boundary).
+- ``txn_id``    [T, 5]  original TxnId packed the same way (slot identity).
+- ``kind``      [T]     int8 Txn.Kind code (primitives.TxnKind) — drives the
+                        witness matrix (Txn.java:221-262) during the join.
+- ``status``    [T]     int8 InternalStatus code (local.cfk.InternalStatus).
+- ``adj``       [T, T]  dependency adjacency: adj[i, j] = 1 iff txn i depends
+                        on (must execute after) txn j.
+- ``active``    [T]     slot-occupied mask.
+
+All shapes are static: T (txn slots) and K (key slots) are capacity bounds;
+slots are recycled by host-side compaction when RedundantBefore advances
+(the GC watermark, RedundantBefore.java:49-529).  Everything in this module is
+a pure function of arrays -> arrays and is jit/shard_map-safe.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# InternalStatus codes mirrored from ..local.cfk.InternalStatus (kept as plain
+# ints here so device code never imports the host control plane).
+TRANSITIVELY_KNOWN = 0
+PREACCEPTED = 1
+ACCEPTED = 2
+COMMITTED = 3
+STABLE = 4
+APPLIED = 5
+INVALIDATED = 6
+
+TS_LANES = 5  # (epoch, hlc_hi, hlc_lo, flags, node)
+
+
+class GraphState(NamedTuple):
+    """One shard's device-resident conflict graph (see module doc)."""
+    key_inc: jax.Array   # [T, K] int8
+    ts: jax.Array        # [T, 5] int32 — execute-at (witnessed-at until fixed)
+    txn_id: jax.Array    # [T, 5] int32 — slot identity
+    kind: jax.Array      # [T] int8 — Txn.Kind code
+    status: jax.Array    # [T] int8
+    adj: jax.Array       # [T, T] int8
+    active: jax.Array    # [T] bool
+
+    @property
+    def txn_slots(self) -> int:
+        return self.key_inc.shape[0]
+
+    @property
+    def key_slots(self) -> int:
+        return self.key_inc.shape[1]
+
+
+def init_state(txn_slots: int, key_slots: int) -> GraphState:
+    """Fresh empty shard state with static capacity (T, K)."""
+    return GraphState(
+        key_inc=jnp.zeros((txn_slots, key_slots), dtype=jnp.int8),
+        ts=jnp.zeros((txn_slots, TS_LANES), dtype=jnp.int32),
+        txn_id=jnp.zeros((txn_slots, TS_LANES), dtype=jnp.int32),
+        kind=jnp.zeros((txn_slots,), dtype=jnp.int8),
+        status=jnp.zeros((txn_slots,), dtype=jnp.int8),
+        adj=jnp.zeros((txn_slots, txn_slots), dtype=jnp.int8),
+        active=jnp.zeros((txn_slots,), dtype=jnp.bool_),
+    )
+
+
+def ts_less(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic a < b over packed-timestamp lanes.
+
+    a, b: [..., 5] int32 (broadcastable).  All lanes are non-negative
+    (Timestamp.pack_lanes bounds, enforced at the host boundary) so signed
+    compare is safe."""
+    lt = a[..., TS_LANES - 1] < b[..., TS_LANES - 1]
+    for lane in range(TS_LANES - 2, -1, -1):
+        lt = (a[..., lane] < b[..., lane]) | ((a[..., lane] == b[..., lane]) & lt)
+    return lt
+
+
+def insert_batch(state: GraphState,
+                 slots: jax.Array,       # [B] int32 target slot per new txn
+                 key_inc: jax.Array,     # [B, K] int8
+                 ts: jax.Array,          # [B, 5] int32
+                 txn_id: jax.Array,      # [B, 5] int32
+                 kind: jax.Array,        # [B] int8
+                 status: jax.Array,      # [B] int8
+                 deps_mask: jax.Array,   # [B, T] int8 — adjacency rows
+                 ) -> GraphState:
+    """Scatter a batch of newly witnessed transactions into their slots.
+
+    Slot assignment is host-side (the control plane picks free slots
+    deterministically); on-device this is a pure scatter so the whole
+    PreAccept batch is one fused update."""
+    return GraphState(
+        key_inc=state.key_inc.at[slots].set(key_inc),
+        ts=state.ts.at[slots].set(ts),
+        txn_id=state.txn_id.at[slots].set(txn_id),
+        kind=state.kind.at[slots].set(kind),
+        status=state.status.at[slots].set(status),
+        adj=state.adj.at[slots].set(deps_mask),
+        active=state.active.at[slots].set(True),
+    )
+
+
+def set_status_batch(state: GraphState, slots: jax.Array,
+                     status: jax.Array) -> GraphState:
+    return state._replace(status=state.status.at[slots].set(status))
+
+
+def set_execute_at_batch(state: GraphState, slots: jax.Array,
+                         ts: jax.Array) -> GraphState:
+    return state._replace(ts=state.ts.at[slots].set(ts))
+
+
+def evict_mask(state: GraphState, keep: jax.Array) -> GraphState:
+    """Clear every slot where keep[i] is False (GC/compaction: RedundantBefore
+    advancing makes applied txns evictable, Cleanup.java semantics).  Also
+    clears dependency edges *onto* evicted slots — an applied/GC'd dependency
+    is no longer waiting-on (Commands.java:704-705 removeRedundantDependencies)."""
+    keep_i8 = keep.astype(jnp.int8)
+    keep_i32 = keep[:, None].astype(jnp.int32)
+    return GraphState(
+        key_inc=state.key_inc * keep_i8[:, None],
+        ts=state.ts * keep_i32,
+        txn_id=state.txn_id * keep_i32,
+        kind=state.kind * keep_i8,
+        status=state.status * keep_i8,
+        adj=state.adj * keep_i8[:, None] * keep_i8[None, :],
+        active=state.active & keep,
+    )
+
+
+def to_host_deps(state: GraphState) -> np.ndarray:
+    """Adjacency back to host as a dense bool matrix (for parity checks)."""
+    return np.asarray(state.adj, dtype=np.int8) != 0

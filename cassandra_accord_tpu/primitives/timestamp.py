@@ -192,6 +192,26 @@ class Timestamp:
         hlc = ((msb & 0xFFFF) << 48) | (lsb >> 16)
         return Timestamp(epoch, hlc, node, lsb & 0xFFFF)
 
+    # TPU lane layout: five non-negative int32 lanes whose lexicographic order
+    # equals the host total order (epoch, hlc, flags, node).  int32 keeps the
+    # device plane free of x64 mode; bounds are checked here at the boundary.
+    LANE_BOUNDS = ((1 << 31) - 1, (1 << 31) - 1, (1 << 31) - 1,
+                   (1 << 16) - 1, (1 << 31) - 1)
+
+    def pack_lanes(self) -> Tuple[int, int, int, int, int]:
+        """(epoch, hlc>>31, hlc&0x7FFFFFFF, flags, node) — the device-table
+        row for this timestamp (see ops.graph_state)."""
+        check_argument(self.epoch < (1 << 31), "epoch exceeds device bound: %s", self.epoch)
+        check_argument(self.hlc < (1 << 62), "hlc exceeds device bound: %s", self.hlc)
+        check_argument(0 <= self.node < (1 << 31), "node exceeds device bound: %s", self.node)
+        return (self.epoch, self.hlc >> 31, self.hlc & 0x7FFFFFFF,
+                self.flags, self.node)
+
+    @staticmethod
+    def unpack_lanes(lanes) -> "Timestamp":
+        epoch, hlc_hi, hlc_lo, flags, node = (int(x) for x in lanes)
+        return Timestamp(epoch, (hlc_hi << 31) | hlc_lo, node, flags)
+
     def __repr__(self) -> str:
         r = "(R)" if self.is_rejected else ""
         return f"[{self.epoch},{self.hlc},{self.node}]{r}"

@@ -8,6 +8,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# Under the axon TPU tunnel the env var is pre-empted (jax_platforms is forced
+# to "axon,cpu"); the config update below reliably pins tests to the virtual
+# 8-device CPU platform regardless.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

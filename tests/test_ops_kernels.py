@@ -1,0 +1,230 @@
+"""Device deps-kernel tests: every kernel checked against a naive NumPy oracle.
+
+The oracle implements the reference semantics directly (per-txn loops over
+CommandsForKey-style conflict scans); the kernels must match bit-exactly —
+this is the "deps-graph parity" requirement from BASELINE.md.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cassandra_accord_tpu import ops
+from cassandra_accord_tpu.ops import graph_state as gs
+from cassandra_accord_tpu.ops.pallas_join import overlap_join_fused
+from cassandra_accord_tpu.primitives.timestamp import Timestamp, TxnId, TxnKind, Domain
+
+T, K, B = 64, 32, 16
+
+
+def _mk_txns(rng: np.random.Generator, n: int):
+    """n random txns touching 1-4 of K keys: (key_inc, lanes, kinds, txn_ids)."""
+    key_inc = np.zeros((n, K), dtype=np.int8)
+    kinds = np.zeros(n, dtype=np.int8)
+    lanes = np.zeros((n, gs.TS_LANES), dtype=np.int32)
+    txn_ids = []
+    for i in range(n):
+        nkeys = rng.integers(1, 5)
+        key_inc[i, rng.choice(K, nkeys, replace=False)] = 1
+        kind = TxnKind(rng.choice([0, 1, 3, 4]))
+        tid = TxnId(epoch=1, hlc=int(rng.integers(1, 500)),
+                    node=int(rng.integers(1, 8)), kind=kind, domain=Domain.KEY)
+        txn_ids.append(tid)
+        kinds[i] = int(kind)
+        lanes[i] = tid.pack_lanes()
+    return key_inc, lanes, kinds, txn_ids
+
+
+def _mk_index(rng: np.random.Generator):
+    key_inc, lanes, kinds, txn_ids = _mk_txns(rng, T)
+    statuses = rng.integers(gs.PREACCEPTED, gs.INVALIDATED + 1, T).astype(np.int8)
+    active = rng.random(T) < 0.9
+    return key_inc, lanes, kinds, statuses, active, txn_ids
+
+
+def _oracle_join(ikey, itid, ikind, istat, iact, bkey, btid, bkind):
+    """Reference semantics, txn by txn (cfk mapReduceActive loop)."""
+    out = np.zeros((len(bkey), len(ikey)), dtype=bool)
+    for bi in range(len(bkey)):
+        for ti in range(len(ikey)):
+            if not iact[ti] or istat[ti] == gs.INVALIDATED:
+                continue
+            if not (bkey[bi] & ikey[ti]).any():
+                continue
+            if not TxnKind(bkind[bi]).witnesses(TxnKind(ikind[ti])):
+                continue
+            if tuple(itid[ti]) < tuple(btid[bi]):
+                out[bi, ti] = True
+    return out
+
+
+@pytest.fixture
+def nprng():
+    return np.random.default_rng(7)
+
+
+def test_pack_lanes_roundtrip_and_order():
+    a = Timestamp(epoch=3, hlc=(1 << 50) + 12345, node=9, flags=0x8000)
+    b = Timestamp(epoch=3, hlc=(1 << 50) + 12346, node=1)
+    assert Timestamp.unpack_lanes(a.pack_lanes()) == a
+    assert (a < b) == (tuple(a.pack_lanes()) < tuple(b.pack_lanes()))
+    # wall-clock-microsecond HLC (the production clock) stays in bounds
+    wall = Timestamp(epoch=10, hlc=1_785_320_667_412_592, node=3)
+    assert all(0 <= x <= bound for x, bound
+               in zip(wall.pack_lanes(), Timestamp.LANE_BOUNDS))
+
+
+def test_overlap_join_parity(nprng):
+    ikey, itid, ikind, istat, iact, _ = _mk_index(nprng)
+    bkey, btid, bkind, _ = _mk_txns(nprng, B)
+    got = np.asarray(ops.overlap_join(
+        jnp.asarray(ikey), jnp.asarray(itid), jnp.asarray(ikind),
+        jnp.asarray(istat), jnp.asarray(iact),
+        jnp.asarray(bkey), jnp.asarray(btid), jnp.asarray(bkind)))
+    want = _oracle_join(ikey, itid, ikind, istat, iact, bkey, btid, bkind)
+    assert (got == want).all()
+
+
+def test_pallas_join_matches_xla(nprng):
+    ikey, itid, ikind, istat, iact, _ = _mk_index(nprng)
+    bkey, btid, bkind, _ = _mk_txns(nprng, B)
+    xla = np.asarray(ops.overlap_join(
+        jnp.asarray(ikey), jnp.asarray(itid), jnp.asarray(ikind),
+        jnp.asarray(istat), jnp.asarray(iact),
+        jnp.asarray(bkey), jnp.asarray(btid), jnp.asarray(bkind)))
+    fused = np.asarray(overlap_join_fused(
+        jnp.asarray(ikey), jnp.asarray(itid), jnp.asarray(ikind),
+        jnp.asarray(istat), jnp.asarray(iact),
+        jnp.asarray(bkey), jnp.asarray(btid), jnp.asarray(bkind)))
+    assert (xla == fused).all()
+
+
+def test_max_conflict_ts_matches_host_proposal(nprng):
+    """Device conflict-max + host unique_now_at_least == host preaccept
+    proposal (local/commands.py preaccept timestamp rule)."""
+    ikey, itid, ikind, istat, iact, itxns = _mk_index(nprng)
+    bkey, btid, bkind, btxns = _mk_txns(nprng, B)
+    deps = _oracle_join(ikey, itid, ikind, istat, iact, bkey, btid, bkind)
+    cmax, any_dep = ops.max_conflict_ts(jnp.asarray(itid), jnp.asarray(deps))
+    cmax, any_dep = np.asarray(cmax), np.asarray(any_dep)
+    for bi in range(B):
+        conf = [tuple(itid[ti]) for ti in range(len(itid)) if deps[bi, ti]]
+        assert bool(any_dep[bi]) == bool(conf)
+        if conf:
+            assert tuple(cmax[bi]) == max(conf)
+            # host proposal rule: txnId wins iff maxConflict < txnId
+            max_conflict = Timestamp.unpack_lanes(cmax[bi])
+            fast = max_conflict < btxns[bi]
+            assert fast == (tuple(cmax[bi]) < tuple(btid[bi]))
+        else:
+            assert tuple(cmax[bi]) == (0,) * gs.TS_LANES
+
+
+def _random_dag(nprng, n=T, p=0.08):
+    adj = (nprng.random((n, n)) < p)
+    adj = np.tril(adj, k=-1)  # i depends on j<i: acyclic
+    return adj.astype(np.int8)
+
+
+def test_transitive_closure(nprng):
+    adj = _random_dag(nprng)
+    got = np.asarray(ops.transitive_closure(jnp.asarray(adj)))
+    want = adj.astype(bool)
+    for k in range(T):
+        want = want | (want[:, k:k + 1] & want[k:k + 1, :])
+    assert (got == want).all()
+
+
+def test_elide_preserves_reachability(nprng):
+    adj = _random_dag(nprng, p=0.15)
+    reduced = np.asarray(ops.elide(jnp.asarray(adj)))
+    assert (reduced <= adj.astype(bool)).all()
+    full = np.asarray(ops.transitive_closure(jnp.asarray(adj)))
+    again = np.asarray(ops.transitive_closure(jnp.asarray(reduced.astype(np.int8))))
+    assert (full == again).all()
+    # and it is minimal on DAGs: removing any kept edge loses reachability
+    kept = np.argwhere(reduced)
+    for (i, j) in kept[:10]:
+        trial = reduced.copy()
+        trial[i, j] = False
+        r = np.asarray(ops.transitive_closure(jnp.asarray(trial.astype(np.int8))))
+        assert not r[i, j]
+
+
+def test_kahn_frontier(nprng):
+    adj = _random_dag(nprng)
+    status = np.full(T, gs.STABLE, dtype=np.int8)
+    done = nprng.random(T) < 0.3
+    status[done] = gs.APPLIED
+    active = np.ones(T, dtype=bool)
+    got = np.asarray(ops.kahn_frontier(
+        jnp.asarray(adj), jnp.asarray(status), jnp.asarray(active)))
+    for i in range(T):
+        deps_done = all(status[j] in (gs.APPLIED, gs.INVALIDATED) or not active[j]
+                        for j in range(T) if adj[i, j])
+        want = active[i] and status[i] == gs.STABLE and deps_done
+        assert got[i] == want, i
+
+
+def test_kahn_levels_respects_edges(nprng):
+    adj = _random_dag(nprng)
+    active = nprng.random(T) < 0.95
+    level = np.asarray(ops.kahn_levels(jnp.asarray(adj), jnp.asarray(active)))
+    for i in range(T):
+        if not active[i]:
+            assert level[i] == -1
+            continue
+        assert level[i] >= 0
+        for j in range(T):
+            if adj[i, j] and active[j]:
+                assert level[i] > level[j]
+
+
+def test_kahn_levels_cycle_flagged():
+    adj = np.zeros((8, 8), dtype=np.int8)
+    adj[0, 1] = adj[1, 2] = adj[2, 0] = 1   # 3-cycle
+    adj[3, 0] = 1                            # depends on the cycle
+    active = np.ones(8, dtype=bool)
+    active[5:] = False
+    level = np.asarray(ops.kahn_levels(jnp.asarray(adj), jnp.asarray(active)))
+    assert (level[[0, 1, 2, 3]] == -1).all()
+    assert level[4] == 0
+    assert (level[5:] == -1).all()
+
+
+def test_scc_condense():
+    n = 8
+    adj = np.zeros((n, n), dtype=np.int8)
+    # cycle {0,1,2}; 3 -> cycle; 4 -> 3; 5 independent; 6,7 inactive
+    adj[0, 1] = adj[1, 2] = adj[2, 0] = 1
+    adj[3, 0] = 1
+    adj[4, 3] = 1
+    active = np.ones(n, dtype=bool)
+    active[6:] = False
+    labels, level = ops.scc_condense(jnp.asarray(adj), jnp.asarray(active))
+    labels, level = np.asarray(labels), np.asarray(level)
+    assert labels[0] == labels[1] == labels[2] == 0
+    assert len({labels[3], labels[4], labels[5], 0}) == 4
+    assert (labels[6:] == -1).all()
+    assert level[0] == level[1] == level[2] == 0
+    assert level[3] == 1 and level[4] == 2 and level[5] == 0
+    assert (level[6:] == -1).all()
+
+
+def test_graph_state_insert_evict(nprng):
+    st = ops.init_state(16, 8)
+    slots = jnp.asarray([0, 3, 7], dtype=jnp.int32)
+    key_inc = jnp.asarray(nprng.integers(0, 2, (3, 8)), dtype=jnp.int8)
+    ts = jnp.asarray(nprng.integers(1, 100, (3, gs.TS_LANES)), dtype=jnp.int32)
+    kind = jnp.asarray([1, 1, 0], dtype=jnp.int8)
+    status = jnp.full((3,), gs.PREACCEPTED, dtype=jnp.int8)
+    deps = jnp.zeros((3, 16), dtype=jnp.int8)
+    st = ops.insert_batch(st, slots, key_inc, ts, ts, kind, status, deps)
+    assert bool(st.active[0]) and bool(st.active[3]) and bool(st.active[7])
+    assert not bool(st.active[1])
+    st = ops.set_status_batch(st, slots, jnp.full((3,), gs.APPLIED, jnp.int8))
+    assert int(st.status[3]) == gs.APPLIED
+    keep = jnp.ones((16,), dtype=jnp.bool_).at[3].set(False)
+    st = ops.evict_mask(st, keep)
+    assert not bool(st.active[3])
+    assert int(st.status[3]) == 0 and int(st.ts[3, 0]) == 0
