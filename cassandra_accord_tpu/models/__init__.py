@@ -1,0 +1,7 @@
+"""Device-resident "models": pytree state + jittable step pipelines."""
+from .conflict_graph import (
+    TxnBatch, preaccept_step, stabilise_step, execute_step, gc_step, txn_step, txn_step_scan,
+)
+
+__all__ = ["TxnBatch", "preaccept_step", "stabilise_step", "execute_step",
+           "gc_step", "txn_step", "txn_step_scan"]

@@ -1,0 +1,152 @@
+"""ConflictGraphModel — the flagship device-resident deps-resolution pipeline.
+
+This is the "model" of the framework in the ML-framework sense: a pytree of
+device state (ops.graph_state.GraphState) plus jittable step functions that
+advance it.  One ``txn_step`` is the TPU analog of everything the reference
+does per transaction between PreAccept and Apply on the metadata plane:
+
+  reference (per txn, scalar Java)              here (per BATCH, one launch)
+  ------------------------------------------    ----------------------------
+  CommandsForKey.mapReduceActive deps scan      overlap_join (MXU matmul)
+  MaxConflicts lookup + proposal                max_conflict_ts (+ host HLC)
+  Commands.commit -> initialiseWaitingOn        insert_batch adjacency rows
+  Commands.maybeExecute / updateWaitingOn       kahn_frontier
+  apply + listener cascade                      set_status_batch(APPLIED)
+
+The control plane (coordination, messages, recovery) stays on the host and
+calls these steps through the DepsResolver boundary (impl/tpu_resolver.py);
+this module is deliberately ignorant of the protocol — it is pure array
+programs, which is what makes it shardable over a Mesh (parallel/mesh.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import graph_state as gs
+from ..ops import deps_kernels as dk
+
+
+class TxnBatch(NamedTuple):
+    """A batch of incoming transactions (host-assembled, device-consumed)."""
+    slots: jax.Array     # [B] int32 — target slot per txn (host-assigned)
+    key_inc: jax.Array   # [B, K] int8
+    txn_id: jax.Array    # [B, 5] int32 packed lanes
+    kind: jax.Array      # [B] int8
+    valid: jax.Array     # [B] bool — allows padding a partial batch
+
+
+@jax.jit
+def preaccept_step(state: gs.GraphState, batch: TxnBatch
+                   ) -> Tuple[gs.GraphState, jax.Array, jax.Array, jax.Array]:
+    """Witness a batch: compute deps, propose conflict-max, insert rows.
+
+    Returns (state', deps [B, T] bool, conflict_max [B, 5], any_dep [B]).
+    Invalid (padding) lanes insert nothing."""
+    deps = dk.overlap_join(state.key_inc, state.txn_id, state.kind,
+                           state.status, state.active,
+                           batch.key_inc, batch.txn_id, batch.kind)
+    deps = deps & batch.valid[:, None]
+    conflict_max, any_dep = dk.max_conflict_ts(state.ts, deps)
+    # padding rows scatter to slot T-1 with valid=False -> masked writes
+    safe_slots = jnp.where(batch.valid, batch.slots, state.txn_slots - 1)
+    state = gs.GraphState(
+        key_inc=state.key_inc.at[safe_slots].set(
+            jnp.where(batch.valid[:, None], batch.key_inc,
+                      state.key_inc[safe_slots])),
+        ts=state.ts.at[safe_slots].set(
+            jnp.where(batch.valid[:, None], batch.txn_id,
+                      state.ts[safe_slots])),
+        txn_id=state.txn_id.at[safe_slots].set(
+            jnp.where(batch.valid[:, None], batch.txn_id,
+                      state.txn_id[safe_slots])),
+        kind=state.kind.at[safe_slots].set(
+            jnp.where(batch.valid, batch.kind, state.kind[safe_slots])),
+        status=state.status.at[safe_slots].set(
+            jnp.where(batch.valid, jnp.int8(gs.PREACCEPTED),
+                      state.status[safe_slots])),
+        adj=state.adj.at[safe_slots].set(
+            jnp.where(batch.valid[:, None], deps.astype(jnp.int8),
+                      state.adj[safe_slots])),
+        active=state.active.at[safe_slots].set(
+            batch.valid | state.active[safe_slots]),
+    )
+    return state, deps, conflict_max, any_dep
+
+
+@jax.jit
+def stabilise_step(state: gs.GraphState, slots: jax.Array,
+                   execute_at: jax.Array, valid: jax.Array) -> gs.GraphState:
+    """Fix executeAt + deps for a batch (the Commit/Stable transition:
+    Commands.commit -> initialiseWaitingOn, Commands.java:289,688)."""
+    safe_slots = jnp.where(valid, slots, state.txn_slots - 1)
+    status = jnp.where(valid, jnp.int8(gs.STABLE), state.status[safe_slots])
+    ts = jnp.where(valid[:, None], execute_at, state.ts[safe_slots])
+    return state._replace(
+        ts=state.ts.at[safe_slots].set(ts),
+        status=state.status.at[safe_slots].set(status))
+
+
+@jax.jit
+def execute_step(state: gs.GraphState) -> Tuple[gs.GraphState, jax.Array]:
+    """One execution wave: find the ready frontier and apply it
+    (Commands.maybeExecute -> Applied, Commands.java:617-666).
+
+    Returns (state', applied_mask [T] bool)."""
+    ready = dk.kahn_frontier(state.adj, state.status, state.active)
+    status = jnp.where(ready, jnp.int8(gs.APPLIED), state.status)
+    return state._replace(status=status), ready
+
+
+@jax.jit
+def gc_step(state: gs.GraphState, redundant_before: jax.Array) -> gs.GraphState:
+    """Evict applied txns with txnId below the GC watermark
+    (RedundantBefore.java:49-529): their slots become reusable.
+
+    redundant_before: [5] int32 packed lanes."""
+    applied = (state.status == gs.APPLIED) | (state.status == gs.INVALIDATED)
+    below = gs.ts_less(state.txn_id, redundant_before[None, :])
+    return gs.evict_mask(state, ~(applied & below & state.active))
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def txn_step(state: gs.GraphState, batch: TxnBatch
+             ) -> Tuple[gs.GraphState, jax.Array, jax.Array]:
+    """The flagship full step: witness a batch, stabilise it at its proposed
+    timestamps (fast path: executeAt == txnId when no conflict is later; slow
+    path: conflict_max.next — the device analog of unique_now_at_least when
+    the conflict dominates the clock), then run one execution wave.  This is
+    what ``__graft_entry__.entry`` compiles and what the bench drives in a
+    loop.  The full protocol uses the host-finalised proposal through the
+    DepsResolver boundary instead; this fused step is the benchable
+    device-only pipeline.
+
+    Returns (state', deps [B, T], applied_mask [T])."""
+    state, deps, conflict_max, any_dep = preaccept_step(state, batch)
+    # fast-path: no conflict later than us -> executeAt = txnId
+    fast = ~any_dep | gs.ts_less(conflict_max, batch.txn_id)
+    exec_at = jnp.where(fast[:, None], batch.txn_id,
+                        gs.ts_next(conflict_max, 0))
+    state = stabilise_step(state, batch.slots, exec_at, batch.valid)
+    state, applied = execute_step(state)
+    return state, deps, applied
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def txn_step_scan(state: gs.GraphState, batches: TxnBatch
+                  ) -> Tuple[gs.GraphState, jax.Array]:
+    """Run txn_step over a whole stacked sequence of batches in ONE device
+    dispatch (lax.scan) — amortises host->device launch latency, the main
+    cost when the control plane streams small batches.  ``batches`` fields
+    carry a leading iteration axis [N, ...].
+
+    Returns (state', applied_count [N] int32)."""
+
+    def body(st, b):
+        st, _deps, applied = txn_step(st, b)
+        return st, jnp.sum(applied.astype(jnp.int32))
+
+    return jax.lax.scan(body, state, batches)

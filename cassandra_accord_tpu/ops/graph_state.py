@@ -97,6 +97,22 @@ def ts_less(a: jax.Array, b: jax.Array) -> jax.Array:
     return lt
 
 
+def ts_next(ts: jax.Array, node_id) -> jax.Array:
+    """Smallest timestamp strictly greater than ts for the given node:
+    hlc+1 (with carry hlc_lo -> hlc_hi), flags cleared, node stamped —
+    device analog of Node.unique_now_at_least when the conflict dominates
+    the local clock (local/node.py).  ts: [..., 5] int32."""
+    lo = ts[..., 2] + 1
+    carry = lo >> 31                 # lo <= 2^31-1, so +1 overflows into bit 31
+    return jnp.stack([
+        ts[..., 0],
+        ts[..., 1] + carry,
+        lo & 0x7FFFFFFF,
+        jnp.zeros_like(ts[..., 3]),
+        jnp.broadcast_to(jnp.asarray(node_id, dtype=ts.dtype), ts[..., 4].shape),
+    ], axis=-1)
+
+
 def insert_batch(state: GraphState,
                  slots: jax.Array,       # [B] int32 target slot per new txn
                  key_inc: jax.Array,     # [B, K] int8

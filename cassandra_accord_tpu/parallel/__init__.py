@@ -1,0 +1,8 @@
+"""Device-mesh parallelism for the conflict-graph data plane."""
+from .mesh import (
+    SHARD, make_mesh, state_specs, batch_specs, shard_state,
+    build_sharded_step, build_sharded_closure,
+)
+
+__all__ = ["SHARD", "make_mesh", "state_specs", "batch_specs", "shard_state",
+           "build_sharded_step", "build_sharded_closure"]

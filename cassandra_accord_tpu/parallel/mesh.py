@@ -1,0 +1,188 @@
+"""Multi-device sharding of the conflict-graph data plane.
+
+The reference scales its metadata plane by splitting key ranges across
+single-threaded ``CommandStore`` shards inside one JVM (CommandStores.java:79,
+§2.4 of SURVEY.md); across machines it scales by topology shards.  The TPU
+build keeps both of those control-plane axes AND adds a device axis: one
+logical command-store shard's conflict graph can itself be sharded over a
+``jax.sharding.Mesh`` so the adjacency matrix and key-incidence matrix grow
+beyond one chip's HBM.
+
+Layout (axis name "shard"):
+- ``key_inc``  [T, K]   row-sharded over txn slots
+- ``ts/txn_id`` [T, 5]  row-sharded
+- ``kind/status/active`` [T] sharded
+- ``adj``      [T, T]   row-sharded (each device owns its txns' outgoing
+                        dependency edges)
+- incoming txn batches are REPLICATED (they are small; every device joins
+  them against its local slice)
+
+Collectives (all via shard_map, riding ICI):
+- overlap_join: none — [B, K] @ [K, T/n] keeps the output sharded by T.
+- conflict-max: jax.lax.all_gather of per-device [B, 5] partial maxes, then
+  a lane-lexicographic reduce (deterministic, device-order independent).
+- kahn frontier: all_gather of the [T/n] done-vector slices (tiny), local
+  [T/n, T] matmul.
+- closure: all_gather of the row-sharded reachability (the classic
+  row-parallel boolean semiring squaring).
+
+This module is exercised on a virtual 8-device CPU mesh in tests and by the
+driver's ``dryrun_multichip``; on hardware the same code spans real chips.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops import graph_state as gs
+from ..ops import deps_kernels as dk
+from ..models.conflict_graph import TxnBatch
+
+SHARD = "shard"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()[:n_devices] if n_devices else jax.devices()
+    return Mesh(np.asarray(devices), (SHARD,))
+
+
+def state_specs() -> gs.GraphState:
+    """PartitionSpec pytree for GraphState: txn-slot axis sharded."""
+    return gs.GraphState(
+        key_inc=P(SHARD, None),
+        ts=P(SHARD, None),
+        txn_id=P(SHARD, None),
+        kind=P(SHARD),
+        status=P(SHARD),
+        adj=P(SHARD, None),
+        active=P(SHARD),
+    )
+
+
+def batch_specs() -> TxnBatch:
+    """Incoming batches are replicated on every device."""
+    return TxnBatch(slots=P(), key_inc=P(), txn_id=P(), kind=P(), valid=P())
+
+
+def shard_state(state: gs.GraphState, mesh: Mesh) -> gs.GraphState:
+    """Place a host-built GraphState onto the mesh with the standard layout."""
+    return jax.tree.map(
+        lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+        state, state_specs())
+
+
+def _lex_max_over_axis0(vals: jax.Array) -> jax.Array:
+    """Lexicographic max over axis 0 of [n, B, 5] lane arrays."""
+    tie = jnp.ones(vals.shape[:2], dtype=jnp.bool_)
+    out = []
+    for lane in range(vals.shape[-1]):
+        m = jnp.where(tie, vals[..., lane], -1)
+        best = jnp.max(m, axis=0)                 # [B]
+        tie = tie & (vals[..., lane] == best[None, :])
+        out.append(jnp.maximum(best, 0))
+    return jnp.stack(out, axis=-1)                # [B, 5]
+
+
+def build_sharded_step(mesh: Mesh):
+    """The full training-step analog, jitted over the mesh: witness a
+    replicated batch against the sharded graph, stabilise, run one execution
+    wave.  Local slot indexing: batch.slots are GLOBAL slot ids; each device
+    claims the ones falling in its slice.
+
+    Returns step(state, batch) -> (state', conflict_max [B,5], applied [T])."""
+
+    def local_step(state: gs.GraphState, batch: TxnBatch):
+        # ---- join against the local row slice (no collective) -------------
+        deps_local = dk.overlap_join(state.key_inc, state.txn_id, state.kind,
+                                     state.status, state.active,
+                                     batch.key_inc, batch.txn_id, batch.kind)
+        deps_local = deps_local & batch.valid[:, None]          # [B, T/n]
+
+        # ---- conflict max: combine per-device partial maxes over ICI ------
+        cmax_local, _ = dk.max_conflict_ts(state.ts, deps_local)  # [B, 5]
+        cmax_all = jax.lax.all_gather(cmax_local, SHARD)          # [n, B, 5]
+        conflict_max = _lex_max_over_axis0(cmax_all)
+        any_dep_local = jnp.any(deps_local, axis=1)
+        any_dep = jax.lax.psum(any_dep_local.astype(jnp.int32), SHARD) > 0
+
+        # ---- insert: each device scatters the batch rows it owns ----------
+        t_local = state.key_inc.shape[0]
+        first = jax.lax.axis_index(SHARD) * t_local
+        mine = batch.valid & (batch.slots >= first) & (batch.slots < first + t_local)
+        # rows this device does not own scatter out of bounds and are dropped
+        # (an in-bounds dummy slot would collide with real inserts)
+        lslot = jnp.where(mine, batch.slots - first, t_local)
+
+        # adjacency rows are GLOBAL width: gather the full deps row for the
+        # owner of each batch txn
+        deps_full = jax.lax.all_gather(deps_local, SHARD, axis=1,
+                                       tiled=True)               # [B, T]
+
+        fast = ~any_dep | gs.ts_less(conflict_max, batch.txn_id)
+        exec_at = jnp.where(fast[:, None], batch.txn_id,
+                            gs.ts_next(conflict_max, 0))
+
+        def put(col, upd):
+            return col.at[lslot].set(upd, mode="drop")
+
+        state = gs.GraphState(
+            key_inc=put(state.key_inc, batch.key_inc),
+            ts=put(state.ts, exec_at),
+            txn_id=put(state.txn_id, batch.txn_id),
+            kind=put(state.kind, batch.kind),
+            status=put(state.status, jnp.full_like(batch.kind, gs.STABLE)),
+            adj=put(state.adj, deps_full.astype(jnp.int8)),
+            active=state.active.at[lslot].set(True, mode="drop"),
+        )
+
+        # ---- one execution wave: frontier over the sharded adjacency ------
+        dep_done_local = ((state.status == gs.APPLIED)
+                          | (state.status == gs.INVALIDATED)
+                          | ~state.active)                        # [T/n]
+        dep_done = jax.lax.all_gather(dep_done_local, SHARD,
+                                      tiled=True)                # [T]
+        waiting = jnp.einsum("ij,j->i", state.adj.astype(jnp.float32),
+                             (~dep_done).astype(jnp.float32)) > 0
+        ready = state.active & (state.status == gs.STABLE) & ~waiting
+        state = state._replace(
+            status=jnp.where(ready, jnp.int8(gs.APPLIED), state.status))
+        applied = jax.lax.all_gather(ready, SHARD, tiled=True)   # [T]
+        return state, conflict_max, applied
+
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(state_specs(), batch_specs()),
+        out_specs=(state_specs(), P(), P()),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def build_sharded_closure(mesh: Mesh):
+    """Row-parallel transitive closure over the mesh: log2(T) rounds of
+    (all_gather rows) then local [T/n, T] @ [T, T] matmul."""
+
+    def local_closure(adj_local: jax.Array) -> jax.Array:        # [T/n, T]
+        t = adj_local.shape[1]
+        iters = max(1, int(t - 1).bit_length())
+
+        def body(_, r_local):
+            r_full = jax.lax.all_gather(r_local, SHARD, tiled=True)  # [T, T]
+            prod = jax.lax.dot_general(
+                r_local.astype(jnp.bfloat16), r_full.astype(jnp.bfloat16),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) > 0.0
+            return r_local | prod
+
+        return jax.lax.fori_loop(0, iters, body, adj_local.astype(jnp.bool_))
+
+    sharded = jax.shard_map(
+        local_closure, mesh=mesh,
+        in_specs=(P(SHARD, None),), out_specs=P(SHARD, None),
+        check_vma=False)
+    return jax.jit(sharded)
