@@ -145,7 +145,7 @@ class _CoordinateTransaction:
                     self.done = True
                     # deps at executeAt = merge of accept-ok deps (Propose.java)
                     stable_deps = Deps.merge([deps] + [ok.deps for ok in accept_oks])
-                    this.stabilise_and_execute(execute_at, stable_deps)
+                    this.stabilise_and_execute(execute_at, stable_deps, ballot)
 
             def on_failure(self, from_node: int, failure: BaseException) -> None:
                 if self.done:
@@ -181,13 +181,14 @@ class _CoordinateTransaction:
                     SaveStatus.STABLE, execute_at, deps, self.result,
                     require_stable_quorum=False).start()
 
-    def stabilise_and_execute(self, execute_at: Timestamp, deps: Deps) -> None:
+    def stabilise_and_execute(self, execute_at: Timestamp, deps: Deps,
+                              ballot: Ballot = Ballot.ZERO) -> None:
         """Slow path: the Stable round must reach a quorum per shard before the
         outcome is reported, so recovery always finds the stable deps
         (Stabilise.java)."""
         _ExecuteTxn(self.node, self.txn_id, self.txn, self.route, self.topologies,
                     SaveStatus.STABLE, execute_at, deps, self.result,
-                    require_stable_quorum=True).start()
+                    require_stable_quorum=True, ballot=ballot).start()
 
 
 class _ExecuteTxn:
@@ -196,7 +197,8 @@ class _ExecuteTxn:
 
     def __init__(self, node: "Node", txn_id: TxnId, txn: Txn, route: Route,
                  topologies, kind_status: SaveStatus, execute_at: Timestamp, deps: Deps,
-                 result: au.Settable, require_stable_quorum: bool):
+                 result: au.Settable, require_stable_quorum: bool,
+                 ballot: Ballot = Ballot.ZERO):
         self.node = node
         self.txn_id = txn_id
         self.txn = txn
@@ -207,6 +209,7 @@ class _ExecuteTxn:
         self.deps = deps
         self.result = result
         self.require_stable_quorum = require_stable_quorum
+        self.ballot = ballot
         self.read_tracker = ReadTracker(topologies)
         self.stable_tracker = QuorumTracker(topologies)
         self.data = None
@@ -271,7 +274,7 @@ class _ExecuteTxn:
         ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
         partial = self.txn.slice(ranges, to == self.node.id)
         return Commit(self.txn_id, scope, wait_for, self.kind_status, self.execute_at,
-                      partial, self.deps.slice(ranges), read=read)
+                      partial, self.deps.slice(ranges), read=read, ballot=self.ballot)
 
     def send_read_retry(self, to: int) -> None:
         request = self.commit_for(to, read=True)
@@ -306,6 +309,46 @@ class _ExecuteTxn:
             self.node.send(to, Apply(
                 self.txn_id, scope, wait_for, Apply.MINIMAL, self.execute_at,
                 self.deps.slice(ranges), None, writes.slice(ranges), txn_result))
+
+
+# ---------------------------------------------------------------------------
+# Recovery re-entry points (CoordinationAdapter.Step.InitiateRecovery): recovery
+# resumes the standard pipeline at the phase matching the strongest evidence it
+# found, carrying its ballot through every subsequent round.
+# ---------------------------------------------------------------------------
+
+def resume_propose(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
+                   result: au.Settable, ballot: Ballot, execute_at: Timestamp,
+                   deps: Deps) -> None:
+    """Re-run the Accept round at ``ballot`` (recovery of an Accepted txn, or
+    re-proposal at txnId when the fast path may have succeeded)."""
+    _CoordinateTransaction(node, txn_id, txn, route, result).propose(ballot, execute_at, deps)
+
+
+def resume_stabilise(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
+                     result: au.Settable, ballot: Ballot, execute_at: Timestamp,
+                     deps: Deps) -> None:
+    """Re-run Stable+Execute (recovery of a Committed/Stable txn)."""
+    _CoordinateTransaction(node, txn_id, txn, route, result) \
+        .stabilise_and_execute(execute_at, deps, ballot)
+
+
+def persist_maximal(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
+                    topologies, execute_at: Timestamp, deps: Deps, writes,
+                    txn_result) -> None:
+    """Broadcast Apply.Maximal — carrying the full txn definition and deps so any
+    replica can apply without prior state (recovery with a known outcome,
+    Persist.java / CoordinationAdapter.java:192-197)."""
+    for to in topologies.nodes():
+        scope = TxnRequest.compute_scope(to, topologies, route)
+        if scope is None:
+            continue
+        wait_for = TxnRequest.compute_wait_for_epoch(to, topologies)
+        ranges = _scope_ranges(node, scope, topologies.current_epoch)
+        node.send(to, Apply(
+            txn_id, scope, wait_for, Apply.MAXIMAL, execute_at,
+            deps.slice(ranges), txn.slice(ranges, include_query=False),
+            writes.slice(ranges) if writes is not None else None, txn_result))
 
 
 def _scope_ranges(node: "Node", scope: Route, max_epoch: int):

@@ -1,17 +1,305 @@
-"""Recovery coordination (Recover.java:80-471) — placeholder pending the recovery
-milestone; see coordinate_transaction for the standard pipeline this resumes into."""
+"""Recovery coordination.
+
+Capability parity with ``accord.coordinate`` Recover / Invalidate
+(Recover.java:80-471, Invalidate.java:1-297): a recovering coordinator promises a
+ballot at a slow-path quorum of every shard via ``BeginRecovery`` (which also
+pre-accepts the txn wherever it was unwitnessed), then resumes the standard pipeline
+at the phase matching the strongest evidence found:
+
+  outcome known (PreApplied+)      -> persist (Apply.Maximal) and report the result
+  Stable                           -> execute (Stable+Read) at the known executeAt
+  (Pre)Committed                   -> stabilise then execute at the known executeAt
+  Accepted                         -> re-propose (Accept round at our ballot) the
+                                      max-ballot proposal's executeAt/deps
+  AcceptedInvalidate               -> propose invalidation, then commit-invalidate
+  all PreAccepted or unwitnessed   -> fast-path analysis (Recover.java:354-380):
+      * any shard where too many electorate members witnessed a timestamp other
+        than txnId (tracker), or any replica that witnessed a conflicting txn
+        ordered after ours without our txnId in its deps => the original
+        coordinator CANNOT have fast-committed: safe to invalidate;
+      * otherwise the fast path may have succeeded, so it must be completed: wait
+        (WaitOnCommit) for any earlier-started txn that proposed an executeAt after
+        ours without witnessing us to commit, retry recovery; when none remain,
+        re-propose at executeAt = txnId with the merged pre-accept deps.
+"""
 from __future__ import annotations
 
-from typing import TYPE_CHECKING
+from typing import TYPE_CHECKING, Dict, List, Optional
 
+from ..messages.base import Callback, TxnRequest
+from ..messages.recovery_messages import (
+    AcceptInvalidate, BeginRecovery, CommitInvalidate, InvalidateNack, InvalidateOk,
+    RecoverNack, RecoverOk, WaitOnCommit, WaitOnCommitOk, max_accepted_reply,
+)
+from ..local.status import Phase, Status
+from ..primitives.deps import Deps
 from ..primitives.route import Route
-from ..primitives.timestamp import TxnId
+from ..primitives.timestamp import Ballot, Timestamp, TxnId
+from ..primitives.txn import Txn
 from ..utils import async_ as au
-from .errors import CoordinationFailed
+from .coordinate_transaction import persist_maximal, resume_propose, resume_stabilise
+from .errors import Exhausted, Invalidated, Preempted, Timeout, Truncated
+from .tracking import QuorumTracker, RecoveryTracker, RequestStatus
 
 if TYPE_CHECKING:
     from ..local.node import Node
 
 
-def recover(node: "Node", txn_id: TxnId, route: Route, result: au.Settable) -> None:
-    result.set_failure(CoordinationFailed(txn_id, "recovery not yet implemented"))
+def recover(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
+            result: au.Settable, ballot: Optional[Ballot] = None) -> None:
+    """Entry point (Recover.recover): pick a ballot above anything we've issued and
+    drive recovery of ``txn_id`` to a terminal outcome.  ``result`` resolves with
+    the txn's Result on success, or Invalidated/Preempted/Exhausted."""
+    if ballot is None:
+        ballot = node.ballot_after(None)
+    _Recover(node, ballot, txn_id, txn, route, result).start()
+
+
+class _Recover:
+    def __init__(self, node: "Node", ballot: Ballot, txn_id: TxnId, txn: Txn,
+                 route: Route, result: au.Settable):
+        self.node = node
+        self.ballot = ballot
+        self.txn_id = txn_id
+        self.txn = txn
+        self.route = route
+        self.result = result
+        self.topologies = node.topology.precise_epochs(route, txn_id.epoch, txn_id.epoch)
+        self.tracker = RecoveryTracker(self.topologies)
+        self.oks: Dict[int, RecoverOk] = {}
+        self.done = False
+
+    # -- BeginRecovery round -------------------------------------------------
+    def start(self) -> None:
+        this = self
+
+        class RecoverCallback(Callback):
+            def on_success(self, from_node: int, reply) -> None:
+                if this.done:
+                    return
+                if isinstance(reply, RecoverNack):
+                    if reply.superseded_by is None:
+                        # the txn was truncated: it is durably decided everywhere
+                        # that matters; report the terminal outcome, don't retry
+                        this.fail(Truncated(this.txn_id, "truncated before recovery"))
+                    else:
+                        this.fail(Preempted(this.txn_id,
+                                            f"recovery superseded by {reply.superseded_by}"))
+                    return
+                this.oks[from_node] = reply
+                fast_path_vote = reply.execute_at is not None \
+                    and reply.execute_at == this.txn_id.as_timestamp()
+                if this.tracker.record_success(from_node, fast_path_vote) is RequestStatus.SUCCESS:
+                    this.analyse()
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                if this.done:
+                    return
+                if this.tracker.record_failure(from_node) is RequestStatus.FAILED:
+                    this.fail(Exhausted(this.txn_id, "recovery quorum unreachable"))
+
+        callback = RecoverCallback()
+        self.node.send_to_each(
+            self.tracker.nodes(),
+            lambda to: self._begin_recovery_for(to),
+            callback)
+
+    def _begin_recovery_for(self, to: int) -> Optional[BeginRecovery]:
+        scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+        if scope is None:
+            return None
+        wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+        ranges = scope.covering
+        partial = self.txn.slice(ranges, to == self.node.id) if ranges is not None \
+            else self.txn.slice(self.node.topology.topology_for_epoch(self.txn_id.epoch).ranges(),
+                                to == self.node.id)
+        return BeginRecovery(self.txn_id, scope, wait_for, partial, self.ballot)
+
+    # -- quorum analysis (Recover.recover, Recover.java:245-380) --------------
+    def analyse(self) -> None:
+        oks = list(self.oks.values())
+        best = max_accepted_reply(oks)
+        merged_deps = Deps.merge([ok.deps for ok in oks])
+
+        if best is not None:
+            status, execute_at = best.status, best.execute_at
+            if status is Status.INVALIDATED or status is Status.TRUNCATED:
+                self.commit_invalidate()
+                return
+            if status.has_been(Status.PRE_APPLIED):
+                # outcome known: make it durable everywhere, report it
+                persist_maximal(self.node, self.txn_id, self.txn, self.route,
+                                self.topologies, execute_at, merged_deps,
+                                best.writes, best.result)
+                self.succeed(best.result)
+                return
+            if status.has_been(Status.STABLE) or status.has_been(Status.PRE_COMMITTED):
+                # executeAt decided: (re-)stabilise at it, then execute.
+                # deps: superset of any committed deps is safe — extra deps only
+                # add waits, and waits resolve in executeAt order.
+                self.done = True
+                resume_stabilise(self.node, self.txn_id, self.txn, self.route,
+                                 self.result, self.ballot, execute_at, merged_deps)
+                self._on_settled()
+                return
+            if status is Status.ACCEPTED:
+                self.done = True
+                resume_propose(self.node, self.txn_id, self.txn, self.route,
+                               self.result, self.ballot, execute_at, merged_deps)
+                self._on_settled()
+                return
+            if status is Status.ACCEPTED_INVALIDATE:
+                self.propose_invalidate()
+                return
+
+        # all replies PreAccepted (BeginRecovery pre-accepts unwitnessed replicas)
+        if self.tracker.rejects_fast_path() or any(ok.rejects_fast_path for ok in oks):
+            # the fast path provably did not commit; nothing else was proposed
+            self.propose_invalidate()
+            return
+
+        ecw = Deps.merge([ok.earlier_committed_witness for ok in oks])
+        eanw = Deps.merge([ok.earlier_accepted_no_witness for ok in oks]).without(ecw.contains)
+        if not eanw.is_empty():
+            # earlier txns proposed to execute after us without witnessing us: if
+            # one commits that way, our fast path provably failed; wait for them
+            # to settle then re-examine from scratch (Recover.java:361-375)
+            self.await_commits(eanw)
+            return
+
+        # the fast path may have committed: complete it at executeAt = txnId
+        self.done = True
+        resume_propose(self.node, self.txn_id, self.txn, self.route, self.result,
+                       self.ballot, self.txn_id.as_timestamp(), merged_deps)
+        self._on_settled()
+
+    # -- await earlier uncommitted no-witness txns ----------------------------
+    def await_commits(self, waiting_on: Deps) -> None:
+        txn_ids = waiting_on.txn_ids()
+        remaining = {"n": len(txn_ids)}
+        this = self
+
+        def one_done(_v, failure):
+            if this.done:
+                return
+            if failure is not None:
+                this.fail(failure)
+                return
+            remaining["n"] -= 1
+            if remaining["n"] == 0:
+                this.retry()
+
+        for dep_id in txn_ids:
+            _AwaitCommit(self.node, dep_id, waiting_on.participants(dep_id)) \
+                .result.add_listener(one_done)
+
+    def retry(self) -> None:
+        self.done = True
+        _Recover(self.node, self.node.ballot_after(self.ballot), self.txn_id,
+                 self.txn, self.route, self.result).start()
+
+    # -- invalidation ---------------------------------------------------------
+    def propose_invalidate(self) -> None:
+        """Propose invalidation at our ballot to a quorum of the home shard
+        (Propose.Invalidate.proposeInvalidate)."""
+        topology = self.node.topology.topology_for_epoch(self.txn_id.epoch)
+        shard = topology.for_key_required(self.route.home_key)
+        tracker = QuorumTracker(self.node.topology.precise_epochs(
+            self.route.home_key_only(), self.txn_id.epoch, self.txn_id.epoch))
+        this = self
+
+        class InvalidateCallback(Callback):
+            def on_success(self, from_node: int, reply) -> None:
+                if this.done:
+                    return
+                if isinstance(reply, InvalidateNack):
+                    if reply.committed:
+                        # txn (pre)committed concurrently: restart recovery to
+                        # pick up the commit evidence
+                        this.retry()
+                    else:
+                        this.fail(Preempted(this.txn_id,
+                                            f"invalidate superseded by {reply.superseded_by}"))
+                    return
+                if reply.status.has_been(Status.PRE_COMMITTED):
+                    this.retry()
+                    return
+                if tracker.record_success(from_node) is RequestStatus.SUCCESS:
+                    this.commit_invalidate()
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                if this.done:
+                    return
+                if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                    this.fail(Exhausted(this.txn_id, "invalidate quorum unreachable"))
+
+        scope = self.route.home_key_only()
+        for to in shard.nodes:
+            self.node.send(to, AcceptInvalidate(self.txn_id, scope, self.txn_id.epoch,
+                                                self.ballot), InvalidateCallback())
+
+    def commit_invalidate(self) -> None:
+        """Broadcast CommitInvalidate across the route and report Invalidated
+        (Propose.Invalidate.proposeAndCommitInvalidate tail)."""
+        for to in self.topologies.nodes():
+            scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+            if scope is None:
+                continue
+            wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+            self.node.send(to, CommitInvalidate(self.txn_id, scope, wait_for))
+        self.fail(Invalidated(self.txn_id, "invalidated during recovery"))
+
+    # -- terminal -------------------------------------------------------------
+    def succeed(self, txn_result) -> None:
+        if not self.done:
+            self.done = True
+            self.node.agent.metrics_events_listener().on_recover(self.txn_id, self.ballot)
+            self.result.set_success(txn_result)
+
+    def fail(self, failure: BaseException) -> None:
+        if not self.done:
+            self.done = True
+            self.result.set_failure(failure)
+
+    def _on_settled(self) -> None:
+        """Metrics hook once a resumed pipeline settles the result."""
+        node, txn_id, ballot = self.node, self.txn_id, self.ballot
+
+        def notify(_v, failure):
+            if failure is None:
+                node.agent.metrics_events_listener().on_recover(txn_id, ballot)
+        self.result.add_listener(notify)
+
+
+class _AwaitCommit:
+    """Quorum WaitOnCommit on one txn's participants (Recover.AwaitCommit)."""
+
+    def __init__(self, node: "Node", txn_id: TxnId, participants):
+        self.result = au.settable()
+        # Deps.participants returns the (RoutingKeys, Ranges) footprint pair
+        keys, ranges = participants
+        if len(keys):
+            route = Route.for_keys(keys[0], keys)
+        else:
+            route = Route.for_ranges(ranges[0].start, ranges)
+        topologies = node.topology.precise_epochs(route, txn_id.epoch, txn_id.epoch)
+        tracker = QuorumTracker(topologies)
+        this = self
+
+        class WaitCallback(Callback):
+            def on_success(self, from_node: int, reply) -> None:
+                if tracker.record_success(from_node) is RequestStatus.SUCCESS:
+                    this.result.try_success(None)
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                    this.result.set_failure(Timeout(txn_id, "await-commit quorum unreachable"))
+
+        callback = WaitCallback()
+        for to in tracker.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, route)
+            if scope is None:
+                continue
+            node.send(to, WaitOnCommit(txn_id, scope,
+                                       TxnRequest.compute_wait_for_epoch(to, topologies)),
+                      callback)

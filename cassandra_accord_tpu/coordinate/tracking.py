@@ -214,5 +214,54 @@ class ReadTracker(AbstractTracker):
         return RequestStatus.NO_CHANGE, sorted(retries)
 
 
+class RecoveryShardTracker(ShardTracker):
+    __slots__ = ("fast_path_rejects",)
+
+    def __init__(self, shard: Shard):
+        super().__init__(shard)
+        self.fast_path_rejects = 0
+
+    def rejects_fast_path(self) -> bool:
+        """True when so many electorate members witnessed the txn at a timestamp
+        other than its txnId that the original coordinator cannot have gathered a
+        fast-path quorum (RecoveryTracker.java:44-47)."""
+        return self.shard.rejects_fast_path(self.fast_path_rejects)
+
+
+class RecoveryTracker(AbstractTracker):
+    """BeginRecovery tracker (RecoveryTracker.java): a slow-path quorum per shard,
+    additionally accounting fast-path vote evidence for the recovery decision."""
+
+    def __init__(self, topologies: Topologies):
+        super().__init__(topologies, RecoveryShardTracker)
+
+    def record_success(self, node: int, accepts_fast_path: bool) -> RequestStatus:
+        newly = False
+        for t in self.trackers_for(node):
+            if node in t.successes or node in t.failures:
+                continue
+            pre = t.has_reached_quorum()
+            t.successes.add(node)
+            if not accepts_fast_path and node in t.shard.fast_path_electorate:
+                t.fast_path_rejects += 1
+            if not pre and t.has_reached_quorum():
+                newly = True
+        if newly and self._all_success(ShardTracker.has_reached_quorum):
+            return RequestStatus.SUCCESS
+        return RequestStatus.NO_CHANGE
+
+    def record_failure(self, node: int) -> RequestStatus:
+        for t in self.trackers_for(node):
+            if node in t.successes or node in t.failures:
+                continue
+            t.failures.add(node)
+            if t.has_failed():
+                return RequestStatus.FAILED
+        return RequestStatus.NO_CHANGE
+
+    def rejects_fast_path(self) -> bool:
+        return any(t.rejects_fast_path() for t in self.trackers)
+
+
 class AppliedTracker(QuorumTracker):
     """Tracks Apply acks reaching a quorum (AppliedTracker)."""

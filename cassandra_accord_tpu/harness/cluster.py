@@ -115,7 +115,7 @@ class LinkConfig:
         self.min_latency_us = min_latency_us
         self.max_latency_us = max_latency_us
 
-    def action(self, from_node: int, to_node: int) -> str:
+    def action(self, from_node: int, to_node: int, message=None) -> str:
         return LinkConfig.DELIVER
 
     def latency_us(self, from_node: int, to_node: int) -> int:
@@ -297,7 +297,7 @@ class Cluster:
     def route(self, from_node: int, to_node: int, request: Request, msg_id: int,
               has_callback: bool) -> None:
         self._count(f"{type(request).__name__}")
-        action = self.link.action(from_node, to_node) if from_node != to_node \
+        action = self.link.action(from_node, to_node, request) if from_node != to_node \
             else LinkConfig.DELIVER
         if action in (LinkConfig.DROP, LinkConfig.FAILURE):
             if action == LinkConfig.FAILURE and has_callback:
@@ -319,7 +319,7 @@ class Cluster:
     def route_reply(self, from_node: int, to_node: int, reply_context: ReplyContext,
                     reply: Reply) -> None:
         self._count(f"{type(reply).__name__}")
-        action = self.link.action(from_node, to_node) if from_node != to_node \
+        action = self.link.action(from_node, to_node, reply) if from_node != to_node \
             else LinkConfig.DELIVER
         if action in (LinkConfig.DROP, LinkConfig.FAILURE):
             return

@@ -74,6 +74,29 @@ def preaccept(safe_store: SafeCommandStore, txn_id: TxnId, partial_txn: PartialT
 
 
 # ---------------------------------------------------------------------------
+# Recover (Commands.java:118)
+# ---------------------------------------------------------------------------
+
+def recover(safe_store: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
+            route: Route, ballot: Ballot) -> AcceptOutcome:
+    """Ballot-gated recovery witness: promise ``ballot`` (refusing lower-ballot
+    coordinators) and pre-accept the txn if this replica never witnessed it
+    (Commands.java:118).  The caller then reports this replica's full evidence
+    (status, accepted ballot, deps, fast-path rejection) via RecoverOk."""
+    command = safe_store.get_or_create(txn_id)
+    if command.save_status.is_truncated:
+        return AcceptOutcome.TRUNCATED
+    if ballot < command.promised:
+        return AcceptOutcome.REJECTED_BALLOT
+    command.promised = command.promised.merge_max(ballot)
+    if not command.has_been(Status.PRE_ACCEPTED):
+        outcome = preaccept(safe_store, txn_id, partial_txn, route, ballot)
+        check_state(outcome is AcceptOutcome.SUCCESS,
+                    "recovery preaccept failed with %s", outcome)
+    return AcceptOutcome.SUCCESS
+
+
+# ---------------------------------------------------------------------------
 # Accept — slow-path proposal (Commands.java:202)
 # ---------------------------------------------------------------------------
 

@@ -121,12 +121,14 @@ class Node(ConfigurationService.Listener):
             else coordinate_transaction(self, txn_id, txn, result))
         return result
 
-    def recover(self, txn_id: TxnId, route: Route) -> au.AsyncResult:
+    def recover(self, txn_id: TxnId, txn: Txn, route: Route) -> au.AsyncResult:
+        """Recover (complete or invalidate) a txn whose coordinator may have died
+        (Node.java:675)."""
         from ..coordinate.recover import recover as do_recover
         result = au.settable()
         self.with_epoch(txn_id.epoch).begin(
             lambda _v, f: result.set_failure(f) if f is not None
-            else do_recover(self, txn_id, route, result))
+            else do_recover(self, txn_id, txn, route, result))
         return result
 
     # -- message dispatch (Node.java:705, :425-527) ---------------------------

@@ -1,0 +1,237 @@
+"""Recovery: completing or invalidating txns whose coordinator died mid-protocol.
+
+Parity targets: accord.coordinate.Recover / messages.BeginRecovery behavior
+(RecoverTest-style scenarios): recovery of a txn found PreAccepted-only is
+invalidated (fast path provably not taken) or completed; recovery of an Accepted /
+Committed / Applied txn completes it; ballot gates preempt stale coordinators.
+"""
+import pytest
+
+from cassandra_accord_tpu.coordinate.errors import (CoordinationFailed, Exhausted,
+                                                    Invalidated, Preempted, Timeout)
+from cassandra_accord_tpu.harness.cluster import Cluster, LinkConfig
+from cassandra_accord_tpu.impl.list_store import ListResult, list_txn
+from cassandra_accord_tpu.local.status import SaveStatus, Status
+from cassandra_accord_tpu.primitives.keys import IntKey, Range
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+
+
+def k(v):
+    return IntKey(v)
+
+
+class DropFrom(LinkConfig):
+    """Drops messages sent from `dead` matching `predicate` once `active`."""
+
+    def __init__(self, rng, dead_node: int):
+        super().__init__(rng)
+        self.dead = dead_node
+        self.predicate = None
+
+    def action(self, from_node: int, to_node: int, message=None) -> str:
+        if self.predicate is not None and from_node == self.dead \
+                and self.predicate(message):
+            return LinkConfig.DROP
+        return LinkConfig.DELIVER
+
+
+def make_cluster(seed=1, nodes=(1, 2, 3), dead=1):
+    shards = [Shard(Range(k(0), k(1000)), list(nodes))]
+    topo = Topology(1, shards)
+    from cassandra_accord_tpu.utils.random import RandomSource
+    link = DropFrom(RandomSource(seed * 7 + 1), dead)
+    cluster = Cluster(topo, seed=seed, link_config=link)
+    return cluster, link
+
+
+def start_and_kill_after(cluster, link, coordinator, kill_after_types, txn):
+    """Coordinate from `coordinator`, dropping its outbound messages of the given
+    types — simulating a coordinator that died after a phase."""
+    link.predicate = lambda m: type(m).__name__ in kill_after_types
+    res = cluster.nodes[coordinator].coordinate(txn)
+    return res
+
+
+def find_status(cluster, node_id, txn_id):
+    for store in cluster.nodes[node_id].command_stores.all_stores():
+        cmd = store.commands.get(txn_id)
+        if cmd is not None:
+            return cmd.save_status
+    return None
+
+
+def the_txn_id(cluster, node_id):
+    """The single coordinated txn's id on the given node (None until witnessed)."""
+    ids = set()
+    for store in cluster.nodes[node_id].command_stores.all_stores():
+        ids.update(store.commands.keys())
+    return next(iter(ids)) if len(ids) == 1 else None
+
+
+def test_recover_preaccepted_only_txn_invalidates():
+    """Coordinator dies after PreAccept round: no Accept/Commit ever sent.  A
+    recovering node must settle the txn (here: invalidate, since with all
+    electorate members reporting preaccept-at-t0 but nothing proposed, the
+    reference invalidates only if fast path impossible — otherwise completes at
+    t0).  Either way every replica converges to a terminal state."""
+    cluster, link = make_cluster()
+    txn = list_txn([], {k(5): "a"})
+    res = start_and_kill_after(cluster, link, 1, {"Commit", "Accept", "Apply"}, txn)
+    # drive until the preaccept replies are in (coordinate() will stall at commit)
+    cluster.run_until(lambda: the_txn_id(cluster, 2) is not None, max_tasks=10_000)
+    txn_id = the_txn_id(cluster, 2)
+    assert txn_id is not None
+
+    link.predicate = None   # network heals; node 1 stays silent as coordinator
+    rec = cluster.nodes[2].recover(txn_id, txn, txn.to_route())
+    assert cluster.run_until(rec.is_done)
+    cluster.run_until_idle()
+    if rec.is_failure():
+        assert isinstance(rec.failure, Invalidated)
+        for n in (2, 3):
+            assert find_status(cluster, n, txn_id) is SaveStatus.INVALIDATED
+    else:
+        # recovery completed the fast-path txn: value applied everywhere
+        for n in (2, 3):
+            assert cluster.stores[n].get(k(5)) == ("a",)
+
+
+def test_recover_applied_txn_returns_result():
+    """Recovery of an already-applied txn persists and reports its outcome."""
+    cluster, link = make_cluster()
+    txn = list_txn([], {k(5): "a"})
+    res = cluster.nodes[1].coordinate(txn)
+    assert cluster.run_until(res.is_done)
+    cluster.run_until_idle()
+    txn_id = the_txn_id(cluster, 2)
+
+    rec = cluster.nodes[2].recover(txn_id, txn, txn.to_route())
+    assert cluster.run_until(rec.is_done)
+    assert rec.is_success(), rec.failure
+    cluster.run_until_idle()
+    for n in cluster.nodes:
+        assert cluster.stores[n].get(k(5)) == ("a",)
+
+
+def test_recover_stable_txn_completes_execution():
+    """Coordinator dies after Stable is durable but before Apply: recovery must
+    finish execution and apply the writes."""
+    cluster, link = make_cluster()
+    txn = list_txn([], {k(7): "x"})
+    res = start_and_kill_after(cluster, link, 1, {"Apply"}, txn)
+    # commit/stable reach replicas; the result may even resolve client-side
+    def stable_somewhere():
+        tid = the_txn_id(cluster, 2)
+        if tid is None:
+            return False
+        return any(find_status(cluster, n, tid) is not None
+                   and find_status(cluster, n, tid).has_been(Status.STABLE)
+                   for n in (2, 3))
+    cluster.run_until(stable_somewhere, max_tasks=50_000)
+    txn_id = the_txn_id(cluster, 2)
+    assert txn_id is not None
+
+    link.predicate = None
+    rec = cluster.nodes[2].recover(txn_id, txn, txn.to_route())
+    assert cluster.run_until(rec.is_done)
+    assert rec.is_success(), rec.failure
+    cluster.run_until_idle()
+    for n in (2, 3):
+        assert cluster.stores[n].get(k(7)) == ("x",)
+
+
+def test_recovered_txn_not_applied_twice():
+    """Recovering an already-applied txn must not re-append the write."""
+    cluster, link = make_cluster()
+    txn = list_txn([], {k(9): "v"})
+    res = cluster.nodes[1].coordinate(txn)
+    assert cluster.run_until(res.is_done)
+    cluster.run_until_idle()
+    txn_id = the_txn_id(cluster, 2)
+
+    for recoverer in (2, 3, 2):
+        rec = cluster.nodes[recoverer].recover(txn_id, txn, txn.to_route())
+        assert cluster.run_until(rec.is_done)
+        cluster.run_until_idle()
+    for n in cluster.nodes:
+        assert cluster.stores[n].get(k(9)) == ("v",)
+
+
+def test_second_recovery_preempts_first_ballot():
+    """A later-ballot recovery preempts an earlier one (ballot gate on replicas)."""
+    cluster, link = make_cluster()
+    txn = list_txn([], {k(4): "z"})
+    res = start_and_kill_after(cluster, link, 1, {"Commit", "Accept", "Apply"}, txn)
+    cluster.run_until(lambda: the_txn_id(cluster, 2) is not None, max_tasks=10_000)
+    txn_id = the_txn_id(cluster, 2)
+    assert txn_id is not None
+    link.predicate = None
+
+    b_low = cluster.nodes[2].ballot_after(None)
+    b_high = cluster.nodes[3].ballot_after(b_low)
+    from cassandra_accord_tpu.coordinate.recover import recover as do_recover
+    from cassandra_accord_tpu.utils import async_ as au
+    # the higher ballot runs first and settles; the stale one must be rejected
+    rec_high = au.settable()
+    do_recover(cluster.nodes[3], txn_id, txn, txn.to_route(), rec_high, ballot=b_high)
+    assert cluster.run_until(rec_high.is_done)
+    cluster.run_until_idle()
+
+    rec_low = au.settable()
+    do_recover(cluster.nodes[2], txn_id, txn, txn.to_route(), rec_low, ballot=b_low)
+    assert cluster.run_until(rec_low.is_done)
+    # stale ballot is preempted — unless the txn already reached a terminal
+    # decision, in which case reporting that decision is also correct
+    if rec_low.is_failure():
+        assert isinstance(rec_low.failure, (Preempted, Invalidated)), rec_low.failure
+
+
+def test_recovery_converges_replicas_after_partial_apply():
+    """Apply reached only node 2; recovery makes node 3 apply too."""
+    class DropApplyTo3(LinkConfig):
+        def action(self, from_node, to_node, message=None):
+            if to_node == 3 and type(message).__name__ == "Apply":
+                return LinkConfig.DROP
+            return LinkConfig.DELIVER
+
+    from cassandra_accord_tpu.utils.random import RandomSource
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    cluster = Cluster(Topology(1, shards), seed=5,
+                      link_config=DropApplyTo3(RandomSource(11)))
+    txn = list_txn([], {k(6): "w"})
+    res = cluster.nodes[1].coordinate(txn)
+    assert cluster.run_until(res.is_done)
+    cluster.run_until_idle()
+    txn_id = the_txn_id(cluster, 2)
+    assert cluster.stores[2].get(k(6)) == ("w",)
+    assert cluster.stores[3].get(k(6)) == ()
+
+    cluster.link = LinkConfig(RandomSource(12))  # heal
+    rec = cluster.nodes[3].recover(txn_id, txn, txn.to_route())
+    assert cluster.run_until(rec.is_done)
+    assert rec.is_success(), rec.failure
+    cluster.run_until_idle()
+    assert cluster.stores[3].get(k(6)) == ("w",)
+
+
+def test_await_commit_resolves_on_commit():
+    """_AwaitCommit (WaitOnCommit quorum) resolves once the txn precommits."""
+    from cassandra_accord_tpu.coordinate.recover import _AwaitCommit
+    from cassandra_accord_tpu.primitives.deps import DepsBuilder
+
+    cluster, link = make_cluster()
+    # a txn held at preaccept (commit/apply dropped)
+    txn = list_txn([], {k(8): "h"})
+    start_and_kill_after(cluster, link, 1, {"Commit", "Accept", "Apply"}, txn)
+    cluster.run_until(lambda: the_txn_id(cluster, 2) is not None, max_tasks=10_000)
+    txn_id = the_txn_id(cluster, 2)
+
+    deps = DepsBuilder().add(k(8).to_routing(), txn_id).build()
+    waiter = _AwaitCommit(cluster.nodes[3], txn_id, deps.participants(txn_id))
+    # heal the network and let recovery settle the txn -> waiter resolves
+    # (WaitOnCommit replies only once the txn is decided on each replica)
+    link.predicate = None
+    rec = cluster.nodes[2].recover(txn_id, txn, txn.to_route())
+    assert cluster.run_until(rec.is_done)
+    assert cluster.run_until(waiter.result.is_done)
+    assert waiter.result.is_success(), waiter.result.failure
