@@ -31,7 +31,8 @@ from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
 from ..utils import async_ as au
 from .errors import Exhausted, Insufficient, Invalidated, Preempted, Timeout
-from .tracking import FastPathTracker, QuorumTracker, ReadTracker, RequestStatus
+from .tracking import (AppliedTracker, FastPathTracker, QuorumTracker, ReadTracker,
+                       RequestStatus)
 
 if TYPE_CHECKING:
     from ..local.node import Node
@@ -104,7 +105,8 @@ class _CoordinateTransaction:
             return None
         wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
         partial = self.txn.slice(_scope_ranges(self.node, scope, max_epoch), to == self.node.id)
-        return PreAccept(self.txn_id, scope, wait_for, partial, max_epoch)
+        return PreAccept(self.txn_id, scope, wait_for, partial, max_epoch,
+                         route=self.route)
 
     def on_preaccepted(self, tracker: FastPathTracker, oks: Dict[int, PreAcceptOk]) -> None:
         # executeAt = fold mergeMax over witnessed timestamps (CoordinatePreAccept:152-163)
@@ -171,7 +173,7 @@ class _CoordinateTransaction:
         keys = self.txn.keys.intersection(ranges) if isinstance(self.txn.keys, _Ranges) \
             else self.txn.keys.slice(ranges)
         return Accept(self.txn_id, scope, wait_for, ballot, execute_at,
-                      keys, deps.slice(ranges))
+                      keys, deps.slice(ranges), route=self.route)
 
     # -- Stabilise + Execute -------------------------------------------------
     def execute(self, path: str, execute_at: Timestamp, deps: Deps) -> None:
@@ -274,7 +276,8 @@ class _ExecuteTxn:
         ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
         partial = self.txn.slice(ranges, to == self.node.id)
         return Commit(self.txn_id, scope, wait_for, self.kind_status, self.execute_at,
-                      partial, self.deps.slice(ranges), read=read, ballot=self.ballot)
+                      partial, self.deps.slice(ranges), read=read, ballot=self.ballot,
+                      route=self.route)
 
     def send_read_retry(self, to: int) -> None:
         request = self.commit_for(to, read=True)
@@ -300,6 +303,24 @@ class _ExecuteTxn:
         writes = self.txn.execute(self.txn_id, self.execute_at, self.data)
         self.result.set_success(txn_result)
 
+        # track Apply acks: at a quorum of every shard the outcome is durable —
+        # broadcast InformDurable so progress logs stand down (PersistTxn.java)
+        applied = AppliedTracker(self.topologies)
+        this = self
+
+        class ApplyCallback(Callback):
+            informed = False
+
+            def on_success(self, from_node: int, reply) -> None:
+                if not self.informed \
+                        and applied.record_success(from_node) is RequestStatus.SUCCESS:
+                    self.informed = True
+                    this.inform_durable()
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                applied.record_failure(from_node)
+
+        callback = ApplyCallback()
         for to in self.topologies.nodes():
             scope = TxnRequest.compute_scope(to, self.topologies, self.route)
             if scope is None:
@@ -308,7 +329,19 @@ class _ExecuteTxn:
             ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
             self.node.send(to, Apply(
                 self.txn_id, scope, wait_for, Apply.MINIMAL, self.execute_at,
-                self.deps.slice(ranges), None, writes.slice(ranges), txn_result))
+                self.deps.slice(ranges), None, writes.slice(ranges), txn_result,
+                route=self.route), callback)
+
+    def inform_durable(self) -> None:
+        from ..local.status import Durability
+        from ..messages.status_messages import InformDurable
+        for to in self.topologies.nodes():
+            scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+            if scope is None:
+                continue
+            wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+            self.node.send(to, InformDurable(self.txn_id, scope, wait_for,
+                                             self.execute_at, Durability.MAJORITY))
 
 
 # ---------------------------------------------------------------------------
@@ -348,7 +381,8 @@ def persist_maximal(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
         node.send(to, Apply(
             txn_id, scope, wait_for, Apply.MAXIMAL, execute_at,
             deps.slice(ranges), txn.slice(ranges, include_query=False),
-            writes.slice(ranges) if writes is not None else None, txn_result))
+            writes.slice(ranges) if writes is not None else None, txn_result,
+            route=route))
 
 
 def _scope_ranges(node: "Node", scope: Route, max_epoch: int):

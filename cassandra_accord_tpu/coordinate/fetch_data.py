@@ -1,0 +1,82 @@
+"""Fetch missing knowledge about a txn from its peers and apply it locally.
+
+Capability parity with ``accord.coordinate.FetchData`` / ``CheckShards``
+(FetchData.java:1-255, CheckShards.java): gather ``CheckStatusOk`` from a quorum of
+every shard the target participants intersect, merge the replies, and propagate the
+merged knowledge into the local stores (the reference's Propagate).  The merged view
+is also handed to the caller — MaybeRecover uses it both as its progress probe and
+to reconstitute the txn body before escalating to full recovery.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Callable, Optional
+
+from ..messages.base import Callback, TxnRequest
+from ..messages.status_messages import CheckStatus, CheckStatusOk, propagate_knowledge
+from ..primitives.route import Route
+from ..primitives.timestamp import TxnId
+from ..utils import async_ as au
+from .errors import Exhausted
+from .tracking import QuorumTracker, RequestStatus
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+
+def check_status_quorum(node: "Node", txn_id: TxnId, route: Route,
+                        include_info: bool = True) -> au.AsyncResult:
+    """CheckStatus at a quorum of every intersecting shard; resolves with the
+    merged CheckStatusOk."""
+    result = au.settable()
+    topologies = node.topology.precise_epochs(route, txn_id.epoch, txn_id.epoch)
+    tracker = QuorumTracker(topologies)
+    merged: dict = {"ok": None}
+
+    class StatusCallback(Callback):
+        done = False
+
+        def on_success(self, from_node: int, reply) -> None:
+            if self.done:
+                return
+            if isinstance(reply, CheckStatusOk):
+                merged["ok"] = reply if merged["ok"] is None else merged["ok"].merge(reply)
+            if tracker.record_success(from_node) is RequestStatus.SUCCESS:
+                self.done = True
+                result.set_success(merged["ok"])
+
+        def on_failure(self, from_node: int, failure: BaseException) -> None:
+            if self.done:
+                return
+            if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                self.done = True
+                result.set_failure(Exhausted(txn_id, "check-status quorum unreachable"))
+
+    callback = StatusCallback()
+    for to in tracker.nodes():
+        scope = TxnRequest.compute_scope(to, topologies, route)
+        if scope is None:
+            continue
+        node.send(to, CheckStatus(txn_id, scope,
+                                  TxnRequest.compute_wait_for_epoch(to, topologies),
+                                  include_info=include_info), callback)
+    return result
+
+
+def fetch_data(node: "Node", txn_id: TxnId, route: Route) -> au.AsyncResult:
+    """Fetch whatever the cluster knows about ``txn_id`` over ``route``, apply it
+    locally (Known upgrade), and resolve with the merged CheckStatusOk."""
+    result = au.settable()
+
+    def on_checked(merged: Optional[CheckStatusOk], failure):
+        if failure is not None:
+            result.set_failure(failure)
+            return
+        if merged is not None:
+            target_route = merged.route if merged.route is not None else route
+            merged.route = target_route
+            propagate_knowledge(node, txn_id, merged)
+        result.set_success(merged)
+
+    check_status_quorum(node, txn_id, route, include_info=True) \
+        .to_chain().begin(on_checked)
+    return result

@@ -113,7 +113,8 @@ class _Recover:
         partial = self.txn.slice(ranges, to == self.node.id) if ranges is not None \
             else self.txn.slice(self.node.topology.topology_for_epoch(self.txn_id.epoch).ranges(),
                                 to == self.node.id)
-        return BeginRecovery(self.txn_id, scope, wait_for, partial, self.ballot)
+        return BeginRecovery(self.txn_id, scope, wait_for, partial, self.ballot,
+                             route=self.route)
 
     # -- quorum analysis (Recover.recover, Recover.java:245-380) --------------
     def analyse(self) -> None:
@@ -269,6 +270,96 @@ class _Recover:
             if failure is None:
                 node.agent.metrics_events_listener().on_recover(txn_id, ballot)
         self.result.add_listener(notify)
+
+
+def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,
+               ballot: Optional[Ballot] = None) -> None:
+    """Standalone invalidation (Invalidate.java): used when a txn blocks others but
+    its definition cannot be recovered (never witnessed at a quorum).  Promises a
+    ballot at a quorum of the home-key shard, then commit-invalidates everywhere.
+    Resolves ``result`` with Invalidated on success (the txn is settled: it will
+    never execute), Preempted if a competing coordinator holds a higher ballot or
+    the txn turns out to be committed."""
+    if ballot is None:
+        ballot = node.ballot_after(None)
+    topologies = node.topology.precise_epochs(route, txn_id.epoch, txn_id.epoch)
+    topology = node.topology.topology_for_epoch(txn_id.epoch)
+    shard = topology.for_key_required(route.home_key)
+    tracker = QuorumTracker(node.topology.precise_epochs(
+        route.home_key_only(), txn_id.epoch, txn_id.epoch))
+    state = {"done": False, "learned_route": None, "has_definition": False}
+
+    def finish(failure: BaseException) -> None:
+        if not state["done"]:
+            state["done"] = True
+            result.set_failure(failure)
+
+    def commit_invalidate() -> None:
+        for to in topologies.nodes():
+            scope = TxnRequest.compute_scope(to, topologies, route)
+            if scope is None:
+                continue
+            node.send(to, CommitInvalidate(
+                txn_id, scope, TxnRequest.compute_wait_for_epoch(to, topologies)))
+        finish(Invalidated(txn_id, "invalidated (definition unrecoverable)"))
+
+    def escalate(learned_route: Route) -> None:
+        """SAFETY (Invalidate.java): our home-shard quorum intersects any fast-path
+        quorum, so if a contacted replica knows the definition the txn may have
+        fast-committed — recover it instead of invalidating.  Fetch the definition
+        cluster-wide, reconstitute, and run full recovery."""
+        state["done"] = True
+        from .fetch_data import fetch_data
+
+        def on_fetched(merged, failure):
+            if failure is not None:
+                result.set_failure(failure)
+                return
+            txn = merged.full_txn() if merged is not None else None
+            full_route = merged.route if merged is not None and merged.route is not None \
+                else learned_route
+            if txn is None:
+                result.set_failure(Exhausted(
+                    txn_id, "definition known but not reconstitutable yet"))
+                return
+            recover(node, txn_id, txn, full_route, result,
+                    ballot=node.ballot_after(ballot))
+
+        fetch_data(node, txn_id, learned_route).add_listener(on_fetched)
+
+    class InvalidateCallback(Callback):
+        def on_success(self, from_node: int, reply) -> None:
+            if state["done"]:
+                return
+            if isinstance(reply, InvalidateNack):
+                finish(Preempted(txn_id, "invalidation superseded"
+                                 if not reply.committed else "txn committed"))
+                return
+            if reply.status.has_been(Status.PRE_COMMITTED):
+                finish(Preempted(txn_id, "txn committed concurrently"))
+                return
+            if reply.has_definition or reply.route is not None:
+                state["has_definition"] = state["has_definition"] or reply.has_definition
+                if reply.route is not None:
+                    state["learned_route"] = reply.route if state["learned_route"] is None \
+                        else state["learned_route"]
+            if tracker.record_success(from_node) is RequestStatus.SUCCESS:
+                if state["has_definition"]:
+                    escalate(state["learned_route"] if state["learned_route"] is not None
+                             else route)
+                else:
+                    commit_invalidate()
+
+        def on_failure(self, from_node: int, failure: BaseException) -> None:
+            if state["done"]:
+                return
+            if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                finish(Exhausted(txn_id, "invalidate quorum unreachable"))
+
+    scope = route.home_key_only()
+    callback = InvalidateCallback()
+    for to in shard.nodes:
+        node.send(to, AcceptInvalidate(txn_id, scope, txn_id.epoch, ballot), callback)
 
 
 class _AwaitCommit:

@@ -25,45 +25,67 @@ from ..coordinate.errors import Timeout
 
 
 class PendingQueue:
-    """Priority queue keyed by virtual micros; seq breaks ties deterministically."""
+    """Priority queue keyed by virtual micros; seq breaks ties deterministically.
+
+    Recurring tasks (periodic progress-log polls, durability cycles) are marked so
+    ``run_until_idle`` can stop when only recurring work remains — the reference's
+    ``processPending`` drains "until only recurring tasks remain"
+    (Cluster.java:215-228)."""
 
     def __init__(self):
         self._heap: List[Tuple[int, int, Callable]] = []
         self._seq = 0
         self.now_micros = 0
+        self._live_nonrecurring = 0
 
-    def add(self, at_micros: int, task: Callable[[], None]) -> "PendingQueue._Entry":
-        entry = PendingQueue._Entry(max(at_micros, self.now_micros), self._seq, task)
+    def add(self, at_micros: int, task: Callable[[], None],
+            recurring: bool = False) -> "PendingQueue._Entry":
+        entry = PendingQueue._Entry(max(at_micros, self.now_micros), self._seq, task,
+                                    recurring, self)
         self._seq += 1
+        if not recurring:
+            self._live_nonrecurring += 1
         heapq.heappush(self._heap, entry)
         return entry
 
-    def add_after(self, delay_micros: int, task: Callable[[], None]):
-        return self.add(self.now_micros + delay_micros, task)
+    def add_after(self, delay_micros: int, task: Callable[[], None],
+                  recurring: bool = False):
+        return self.add(self.now_micros + delay_micros, task, recurring)
 
     def pop(self) -> Optional[Callable]:
         while self._heap:
             entry = heapq.heappop(self._heap)
             if entry.cancelled:
                 continue
+            if not entry.recurring:
+                self._live_nonrecurring -= 1
             self.now_micros = max(self.now_micros, entry.at)
             return entry.task
         return None
+
+    def has_nonrecurring(self) -> bool:
+        return self._live_nonrecurring > 0
 
     def __len__(self):
         return sum(1 for e in self._heap if not e.cancelled)
 
     class _Entry:
-        __slots__ = ("at", "seq", "task", "cancelled")
+        __slots__ = ("at", "seq", "task", "cancelled", "recurring", "_queue")
 
-        def __init__(self, at: int, seq: int, task: Callable):
+        def __init__(self, at: int, seq: int, task: Callable, recurring: bool = False,
+                     queue: "PendingQueue" = None):
             self.at = at
             self.seq = seq
             self.task = task
             self.cancelled = False
+            self.recurring = recurring
+            self._queue = queue
 
         def cancel(self):
-            self.cancelled = True
+            if not self.cancelled:
+                self.cancelled = True
+                if not self.recurring and self._queue is not None:
+                    self._queue._live_nonrecurring -= 1
 
         def __lt__(self, other):
             return (self.at, self.seq) < (other.at, other.seq)
@@ -88,9 +110,11 @@ class SimScheduler(Scheduler):
             if state["cancelled"]:
                 return
             run()
-            state["entry"] = self.queue.add_after(int(interval_s * 1_000_000), fire)
+            state["entry"] = self.queue.add_after(int(interval_s * 1_000_000), fire,
+                                                  recurring=True)
 
-        state["entry"] = self.queue.add_after(int(interval_s * 1_000_000), fire)
+        state["entry"] = self.queue.add_after(int(interval_s * 1_000_000), fire,
+                                              recurring=True)
 
         class _S(Scheduler.Scheduled):
             def cancel(self_inner):
@@ -269,7 +293,9 @@ class Cluster:
 
     def __init__(self, topology: Topology, seed: int = 1, num_shards: int = 1,
                  link_config: Optional[LinkConfig] = None,
-                 reply_timeout_s: float = 2.0):
+                 reply_timeout_s: float = 2.0,
+                 progress_log: bool = False,
+                 progress_poll_s: float = 0.5):
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
         self.scheduler = SimScheduler(self.queue)
@@ -281,6 +307,10 @@ class Cluster:
         self.nodes: Dict[int, Node] = {}
         self.sinks: Dict[int, SimMessageSink] = {}
         self.stores: Dict[int, ListStore] = {}
+        plf = None
+        if progress_log:
+            from ..impl.progress_log import progress_log_factory
+            plf = progress_log_factory(progress_poll_s)
         agent = SimAgent(self)
         for node_id in sorted(topology.nodes()):
             sink = SimMessageSink(node_id, self)
@@ -291,7 +321,8 @@ class Cluster:
                 node_id, sink, SimConfigService(self, node_id), agent,
                 self.scheduler, store, self.rng.fork(),
                 now_micros=lambda: self.queue.now_micros,
-                num_shards=num_shards)
+                num_shards=num_shards,
+                progress_log_factory=plf)
 
     # -- message routing ----------------------------------------------------
     def route(self, from_node: int, to_node: int, request: Request, msg_id: int,
@@ -332,9 +363,10 @@ class Cluster:
 
     # -- execution ----------------------------------------------------------
     def run_until_idle(self, max_tasks: int = 1_000_000) -> int:
-        """Drain the queue; returns tasks executed. Raises any node failure."""
+        """Drain the queue until only recurring tasks remain; returns tasks
+        executed. Raises any node failure."""
         n = 0
-        while n < max_tasks:
+        while n < max_tasks and self.queue.has_nonrecurring():
             task = self.queue.pop()
             if task is None:
                 break
@@ -357,6 +389,11 @@ class Cluster:
             if self.failures:
                 raise self.failures[0]
         return predicate()
+
+    def run_for(self, sim_seconds: float, max_tasks: int = 1_000_000) -> None:
+        """Advance simulated time by ``sim_seconds``, executing everything due."""
+        deadline = self.queue.now_micros + int(sim_seconds * 1_000_000)
+        self.run_until(lambda: self.queue.now_micros >= deadline, max_tasks)
 
     @property
     def now_micros(self) -> int:

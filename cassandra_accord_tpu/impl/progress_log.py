@@ -1,0 +1,316 @@
+"""The reference progress log: home-shard liveness monitoring + blocked-dependency
+resolution.
+
+Capability parity with ``accord.impl.SimpleProgressLog`` (SimpleProgressLog.java:78-729):
+
+- **CoordinateState** (home shard only): every txn whose progress shard is this
+  store is monitored until durably settled.  Each poll compares the txn's
+  ProgressToken against the last poll; no advancement means the coordinator may
+  have died, so escalate through ``maybe_recover`` (CheckStatus probe, then full
+  recovery / invalidation).  Polls are staggered by the owning node's scheduler.
+
+- **BlockingState**: when a Stable command reports it is waiting on a dependency
+  (``waiting`` callback), the blocking txn is monitored; if it stays undecided
+  locally, fetch its state from its participants' replicas (FetchData -> local
+  Propagate upgrade); if the whole cluster has nothing committed for it, recovery
+  of the *blocking* txn is escalated the same way (it was pre-accepted by our
+  PreAccept round, so its home shard may know nothing — recovery invalidates it).
+"""
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING, Dict, Optional
+
+from ..api.interfaces import ProgressLog
+from ..local.status import SaveStatus, Status
+from ..primitives.route import Route
+from ..primitives.timestamp import TxnId
+from ..utils.invariants import check_state
+
+if TYPE_CHECKING:
+    from ..local.command_store import CommandStore
+
+
+class Progress(enum.Enum):
+    EXPECTED = 0        # progress expected from elsewhere; check next poll
+    NO_PROGRESS = 1     # nothing moved since last poll; escalate now
+    INVESTIGATING = 2   # a probe/recovery is in flight
+    DONE = 3
+
+
+class _CoordinateState:
+    __slots__ = ("txn_id", "route", "progress", "token")
+
+    def __init__(self, txn_id: TxnId, route: Route):
+        self.txn_id = txn_id
+        self.route = route
+        self.progress = Progress.EXPECTED
+        self.token = None
+
+
+class _BlockingState:
+    __slots__ = ("txn_id", "route", "progress", "token")
+
+    def __init__(self, txn_id: TxnId, route: Route):
+        self.txn_id = txn_id
+        self.route = route
+        self.progress = Progress.EXPECTED
+        self.token = None
+
+
+class _NonHomeState:
+    """A txn pre-accepted here whose home shard is elsewhere: if it stays
+    undecided, tell the home shard it exists (InformHomeOfTxn semantics) so its
+    progress log starts monitoring."""
+    __slots__ = ("txn_id", "route", "polls")
+
+    def __init__(self, txn_id: TxnId, route: Route):
+        self.txn_id = txn_id
+        self.route = route
+        self.polls = 0
+
+
+class SimpleProgressLog(ProgressLog):
+    """One instance per CommandStore; all callbacks arrive inside the store."""
+
+    def __init__(self, store: "CommandStore", poll_interval_s: float = 0.5):
+        self.store = store
+        self.node = store.node
+        self.coordinating: Dict[TxnId, _CoordinateState] = {}
+        self.blocking: Dict[TxnId, _BlockingState] = {}
+        self.non_home: Dict[TxnId, _NonHomeState] = {}
+        self._scheduled = self.node.scheduler.recurring(poll_interval_s, self._poll)
+
+    def close(self) -> None:
+        self._scheduled.cancel()
+
+    # -- lifecycle callbacks (home shard monitoring) -------------------------
+    def _track(self, command, progress_shard: bool) -> None:
+        if command.route is None:
+            return
+        if not progress_shard:
+            if command.txn_id not in self.non_home and command.route.full:
+                self.non_home[command.txn_id] = _NonHomeState(command.txn_id, command.route)
+            return
+        state = self.coordinating.get(command.txn_id)
+        if state is None:
+            self.coordinating[command.txn_id] = _CoordinateState(command.txn_id, command.route)
+
+    def unwitnessed(self, txn_id, home_key, progress_shard) -> None:
+        if progress_shard and txn_id not in self.coordinating:
+            cmd = self.store.commands.get(txn_id)
+            if cmd is not None and cmd.route is not None:
+                self.coordinating[txn_id] = _CoordinateState(txn_id, cmd.route)
+
+    def pre_accepted(self, command, progress_shard) -> None:
+        self._track(command, progress_shard)
+
+    def accepted(self, command, progress_shard) -> None:
+        self._track(command, progress_shard)
+
+    def precommitted(self, command) -> None:
+        pass
+
+    def stable(self, command, progress_shard) -> None:
+        self._track(command, progress_shard)
+
+    def ready_to_execute(self, command) -> None:
+        pass
+
+    def executed(self, command, progress_shard) -> None:
+        # outcome reached locally: the home shard's liveness duty is discharged
+        # (durability scheduling handles global durability)
+        self._done(command.txn_id)
+
+    def durable(self, command) -> None:
+        # durability discharges home-shard monitoring, but NOT blocked-dependency
+        # tracking: a dep durable elsewhere may still need its writes applied HERE
+        self.coordinating.pop(command.txn_id, None)
+        self.non_home.pop(command.txn_id, None)
+
+    def invalidated(self, command, progress_shard) -> None:
+        self._done(command.txn_id)
+
+    def clear(self, txn_id) -> None:
+        self._done(txn_id)
+
+    def _done(self, txn_id: TxnId) -> None:
+        self.coordinating.pop(txn_id, None)
+        self.blocking.pop(txn_id, None)
+        self.non_home.pop(txn_id, None)
+
+    # -- blocked-dependency callbacks ----------------------------------------
+    def waiting(self, blocked_by, blocked_until, blocked_on_route,
+                blocked_on_participants) -> None:
+        if blocked_by in self.blocking:
+            return
+        route = _route_for_participants(blocked_by, blocked_on_route,
+                                        blocked_on_participants)
+        if route is None:
+            return
+        self.blocking[blocked_by] = _BlockingState(blocked_by, route)
+
+    # -- the poll loop (SimpleProgressLog.run) --------------------------------
+    def _poll(self) -> None:
+        self.store.execute(lambda _safe_store: self._poll_in_store())
+
+    def _poll_in_store(self) -> None:
+        from ..coordinate.maybe_recover import ProgressToken
+
+        for txn_id in list(self.coordinating.keys()):
+            state = self.coordinating.get(txn_id)
+            if state is None or state.progress is Progress.INVESTIGATING:
+                continue
+            command = self.store.commands.get(txn_id)
+            if command is not None and (
+                    command.save_status.ordinal >= SaveStatus.APPLIED.ordinal):
+                self._done(txn_id)
+                continue
+            local_token = None if command is None else ProgressToken(
+                command.durability, command.save_status.ordinal, command.promised)
+            if state.token is None or (local_token is not None
+                                       and local_token.advanced_from(state.token)):
+                # first poll / local progress since last poll: give it a cycle
+                state.token = local_token
+                state.progress = Progress.EXPECTED
+                continue
+            state.progress = Progress.INVESTIGATING
+            self._investigate(state)
+
+        for txn_id in list(self.blocking.keys()):
+            state = self.blocking.get(txn_id)
+            if state is None or state.progress is Progress.INVESTIGATING:
+                continue
+            command = self.store.commands.get(txn_id)
+            if command is not None and self._locally_resolved(command):
+                self.blocking.pop(txn_id, None)
+                continue
+            if state.progress is Progress.EXPECTED:
+                # freshly blocked: give the normal pipeline one poll cycle
+                state.progress = Progress.NO_PROGRESS
+                continue
+            state.progress = Progress.INVESTIGATING
+            self._resolve_blocked(state)
+
+        for txn_id in list(self.non_home.keys()):
+            state = self.non_home.get(txn_id)
+            command = self.store.commands.get(txn_id)
+            if command is None or command.has_been(Status.PRE_COMMITTED):
+                self.non_home.pop(txn_id, None)
+                continue
+            state.polls += 1
+            if state.polls >= 2:
+                self._inform_home(state)
+                self.non_home.pop(txn_id, None)
+
+    @staticmethod
+    def _locally_resolved(command) -> bool:
+        """A blocking dep no longer blocks anyone here: applied locally, or will
+        never execute."""
+        return (command.save_status.ordinal >= SaveStatus.APPLIED.ordinal
+                or command.save_status is SaveStatus.INVALIDATED
+                or command.save_status.is_truncated)
+
+    def _investigate(self, state: _CoordinateState) -> None:
+        from ..coordinate.maybe_recover import maybe_recover
+
+        def on_done(outcome, failure):
+            current = self.coordinating.get(state.txn_id)
+            if failure is not None:
+                if current is not None:
+                    current.progress = Progress.NO_PROGRESS
+                return
+            if outcome.settled:
+                self._done(state.txn_id)
+            elif current is not None:
+                current.token = outcome.token
+                current.progress = Progress.EXPECTED
+
+        maybe_recover(self.node, state.txn_id, state.route, state.token) \
+            .add_listener(on_done)
+
+    def _resolve_blocked(self, state: _BlockingState) -> None:
+        """One CheckStatus quorum probe (fetch_data, which also propagates any
+        knowledge found into our stores); if the blocking txn is undecided
+        cluster-wide AND made no progress since the last probe, drive it to a
+        decision: recover when the definition reconstitutes, invalidate when it
+        cannot (it was never durably witnessed)."""
+        from ..coordinate.errors import Invalidated
+        from ..coordinate.fetch_data import fetch_data
+        from ..coordinate.maybe_recover import ProgressToken
+        from ..coordinate.recover import invalidate as do_invalidate, recover as do_recover
+        from ..utils import async_ as au
+
+        def on_fetched(merged, failure):
+            current = self.blocking.get(state.txn_id)
+            if current is None:
+                return
+            if failure is not None:
+                current.progress = Progress.NO_PROGRESS
+                return
+            # fetch_data propagated any knowledge found; resolved iff the dep is
+            # now APPLIED (or settled) *locally* — being merely (pre)committed
+            # cluster-wide doesn't unblock local execution
+            command = self.store.commands.get(state.txn_id)
+            if command is not None and self._locally_resolved(command):
+                self.blocking.pop(state.txn_id, None)
+                return
+            token = ProgressToken.of(merged) if merged is not None else None
+            if token is not None and token.advanced_from(current.token):
+                current.token = token
+                current.progress = Progress.NO_PROGRESS  # escalate next poll if stalled
+                return
+
+            # stalled and undecided: settle it
+            rec = au.settable()
+            txn = merged.full_txn() if merged is not None else None
+            full_route = merged.route if merged is not None and merged.route is not None \
+                and merged.route.full else state.route
+            if txn is not None:
+                do_recover(self.node, state.txn_id, txn, full_route, rec)
+            else:
+                do_invalidate(self.node, state.txn_id, full_route, rec)
+
+            def on_settled(_value, rec_failure):
+                cur = self.blocking.get(state.txn_id)
+                if cur is not None:
+                    if rec_failure is None or isinstance(rec_failure, Invalidated):
+                        self.blocking.pop(state.txn_id, None)
+                    else:
+                        cur.progress = Progress.NO_PROGRESS
+            rec.add_listener(on_settled)
+
+        fetch_data(self.node, state.txn_id, state.route).add_listener(on_fetched)
+
+    def _inform_home(self, state: _NonHomeState) -> None:
+        """Send InformOfTxn to the home-shard replicas (InformHomeOfTxn)."""
+        from ..messages.status_messages import InformOfTxn
+        topology = self.node.topology.topology_for_epoch(state.txn_id.epoch)
+        shard = topology.for_key(state.route.home_key)
+        if shard is None:
+            return
+        for to in shard.nodes:
+            if to != self.node.id:
+                self.node.send(to, InformOfTxn(state.txn_id, state.route,
+                                               state.txn_id.epoch))
+
+
+def _route_for_participants(txn_id: TxnId, waiter_route: Optional[Route],
+                            participants) -> Optional[Route]:
+    """Best route hint for a blocking txn: its participants from the waiter's
+    deps — a SUBSET of its true route, so the hint is a partial route (it must
+    never be mistaken for the full footprint by txn reconstitution)."""
+    if participants is not None:
+        keys, ranges = participants
+        if len(keys):
+            return Route(keys[0], keys, full=False)
+        if len(ranges):
+            return Route(ranges[0].start, ranges, full=False)
+    return waiter_route
+
+
+def progress_log_factory(poll_interval_s: float = 0.5):
+    """Factory suitable for Node(progress_log_factory=...)."""
+    def make(store: "CommandStore") -> SimpleProgressLog:
+        return SimpleProgressLog(store, poll_interval_s)
+    return make

@@ -318,7 +318,9 @@ def maybe_execute(safe_store: SafeCommandStore, command: Command,
             if command.save_status not in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
                 return False  # re-entrant notification already advanced us
         if command.waiting_on.is_waiting():
-            safe_store.progress_log().waiting(blocking, None, command.route, None)
+            participants = command.partial_deps.participants(blocking) \
+                if command.partial_deps is not None else None
+            safe_store.progress_log().waiting(blocking, None, command.route, participants)
             return False
         # frontier drained during notification but no one executed us: fall through
 

@@ -176,8 +176,22 @@ class Node(ConfigurationService.Listener):
                                               map_fn, reduce_fn)
 
     def for_each_local(self, unseekables, min_epoch: int, max_epoch: int,
-                       fn: Callable[[SafeCommandStore], None]) -> au.AsyncChain:
-        return self.command_stores.for_each(unseekables, min_epoch, max_epoch, fn)
+                       fn: Callable[[SafeCommandStore], None]) -> au.AsyncResult:
+        """Run ``fn`` in every intersecting store.  EAGER (unlike map_reduce_
+        consume_local): the chain is begun here — fire-and-forget callers
+        (CommitInvalidate, Propagate, Inform*) must not silently no-op."""
+        chain = self.command_stores.for_each(unseekables, min_epoch, max_epoch, fn)
+        result = au.settable()
+
+        def on_done(_value, failure):
+            if failure is not None:
+                self.agent.on_uncaught_exception(failure)
+                result.set_failure(failure)
+            else:
+                result.set_success(None)
+
+        chain.begin(on_done)
+        return result
 
     # -- route computation (Node.java:604-624) --------------------------------
     def compute_route(self, txn: Txn) -> Route:

@@ -228,13 +228,16 @@ def recovery_evidence(safe_store: SafeCommandStore, txn_id: TxnId, keys):
 # ---------------------------------------------------------------------------
 
 class BeginRecovery(TxnRequest):
-    __slots__ = ("partial_txn", "ballot")
+    __slots__ = ("partial_txn", "ballot", "route")
 
     def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
-                 partial_txn: PartialTxn, ballot: Ballot):
+                 partial_txn: PartialTxn, ballot: Ballot,
+                 route: Optional[Route] = None):
         super().__init__(txn_id, scope, wait_for_epoch)
         self.partial_txn = partial_txn
         self.ballot = ballot
+        # full route (BeginRecovery.java route field)
+        self.route = route if route is not None else scope
 
     @property
     def type(self):
@@ -242,9 +245,10 @@ class BeginRecovery(TxnRequest):
 
     def process(self, node: "Node", from_node: int, reply_context) -> None:
         txn_id, partial_txn, ballot, scope = self.txn_id, self.partial_txn, self.ballot, self.scope
+        route = self.route
 
         def map_fn(safe_store: SafeCommandStore):
-            outcome = C.recover(safe_store, txn_id, partial_txn, scope, ballot)
+            outcome = C.recover(safe_store, txn_id, partial_txn, route, ballot)
             if outcome is C.AcceptOutcome.TRUNCATED:
                 return RecoverNack(None)
             if outcome is C.AcceptOutcome.REJECTED_BALLOT:
@@ -288,11 +292,13 @@ class BeginRecovery(TxnRequest):
 # ---------------------------------------------------------------------------
 
 class InvalidateOk(Reply):
-    __slots__ = ("status", "route")
+    __slots__ = ("status", "route", "has_definition")
 
-    def __init__(self, status: Status, route: Optional[Route]):
+    def __init__(self, status: Status, route: Optional[Route],
+                 has_definition: bool = False):
         self.status = status
         self.route = route
+        self.has_definition = has_definition
 
     @property
     def type(self):
@@ -342,14 +348,20 @@ class AcceptInvalidate(TxnRequest):
                 return InvalidateNack(command.promised)
             if outcome in (C.AcceptOutcome.REDUNDANT, C.AcceptOutcome.TRUNCATED):
                 return InvalidateNack(None, committed=True)
-            return InvalidateOk(command.status, command.route)
+            return InvalidateOk(command.status, command.route,
+                                has_definition=command.partial_txn is not None)
 
         def reduce_fn(a, b):
             if isinstance(a, InvalidateNack):
                 return a
             if isinstance(b, InvalidateNack):
                 return b
-            return a if a.status >= b.status else b
+            keep = a if a.status >= b.status else b
+            other = b if keep is a else a
+            if not keep.has_definition and other.has_definition:
+                keep = InvalidateOk(keep.status, other.route if keep.route is None
+                                    else keep.route, True)
+            return keep
 
         def consume(result, failure):
             if failure is not None:

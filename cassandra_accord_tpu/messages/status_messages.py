@@ -1,0 +1,250 @@
+"""Status interrogation and knowledge propagation.
+
+Capability parity with ``accord.messages.CheckStatus`` / ``Propagate``
+(CheckStatus.java:21-933, Propagate.java:1-546): ``CheckStatus`` reports everything a
+replica knows about a txn — save status, ballots, executeAt, durability, route, and
+(with ``include_info``) the partial txn/deps/writes/result — and the caller merges
+replies field-wise into a single knowledge view.  ``propagate_knowledge`` applies a
+merged view to the local stores, upgrading the local ``Known`` (the reference's
+local-only Propagate message family).
+
+Also: the hint messages ``InformOfTxn`` (tell the home shard an unwitnessed txn
+exists, InformOfTxnId.java) and ``InformDurable`` (durability notice, InformDurable.java).
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Optional
+
+from ..local import commands as C
+from ..local.command_store import SafeCommandStore
+from ..local.status import Durability, SaveStatus, Status
+from ..primitives.deps import Deps
+from ..primitives.route import Route
+from ..primitives.timestamp import Ballot, Timestamp, TxnId
+from ..primitives.txn import PartialTxn, Txn, Writes
+from .base import MessageType, Reply, TxnRequest
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+
+class CheckStatusOk(Reply):
+    """One replica's (or a merge of several replicas') knowledge of a txn."""
+
+    __slots__ = ("txn_id", "save_status", "promised", "accepted", "execute_at",
+                 "durability", "route", "partial_txn", "partial_deps", "writes",
+                 "result")
+
+    def __init__(self, txn_id: TxnId, save_status: SaveStatus, promised: Ballot,
+                 accepted: Ballot, execute_at: Optional[Timestamp],
+                 durability: Durability, route: Optional[Route],
+                 partial_txn: Optional[PartialTxn], partial_deps: Optional[Deps],
+                 writes: Optional[Writes], result):
+        self.txn_id = txn_id
+        self.save_status = save_status
+        self.promised = promised
+        self.accepted = accepted
+        self.execute_at = execute_at
+        self.durability = durability
+        self.route = route
+        self.partial_txn = partial_txn
+        self.partial_deps = partial_deps
+        self.writes = writes
+        self.result = result
+
+    @property
+    def type(self):
+        return MessageType.CHECK_STATUS_RSP
+
+    @staticmethod
+    def of(txn_id: TxnId, command) -> "CheckStatusOk":
+        return CheckStatusOk(txn_id, command.save_status, command.promised,
+                             command.accepted_or_committed, command.execute_at,
+                             command.durability, command.route, command.partial_txn,
+                             command.partial_deps, command.writes, command.result)
+
+    @staticmethod
+    def empty(txn_id: TxnId) -> "CheckStatusOk":
+        return CheckStatusOk(txn_id, SaveStatus.NOT_DEFINED, Ballot.ZERO, Ballot.ZERO,
+                             None, Durability.NOT_DURABLE, None, None, None, None, None)
+
+    def merge(self, other: "CheckStatusOk") -> "CheckStatusOk":
+        """Field-wise knowledge merge (CheckStatus.CheckStatusOk.merge): the
+        higher save status's decision fields win; routes union; txn/deps merge."""
+        a, b = self, other
+        if b.save_status.ordinal > a.save_status.ordinal:
+            a, b = b, a
+        route = a.route
+        if route is None:
+            route = b.route
+        elif b.route is not None and b.route.home_key == route.home_key:
+            route = route.union(b.route)
+        partial_txn = a.partial_txn
+        if partial_txn is None:
+            partial_txn = b.partial_txn
+        elif b.partial_txn is not None:
+            partial_txn = partial_txn.with_merged(b.partial_txn)
+        partial_deps = a.partial_deps
+        if partial_deps is None:
+            partial_deps = b.partial_deps
+        elif b.partial_deps is not None and a.save_status.ordinal == b.save_status.ordinal:
+            # same knowledge tier: deps slices from different shards merge
+            partial_deps = partial_deps.with_merged(b.partial_deps)
+        return CheckStatusOk(
+            a.txn_id, a.save_status, a.promised.merge_max(b.promised),
+            a.accepted.merge_max(b.accepted),
+            a.execute_at if a.execute_at is not None else b.execute_at,
+            max(a.durability, b.durability), route, partial_txn, partial_deps,
+            a.writes if a.writes is not None else b.writes,
+            a.result if a.result is not None else b.result)
+
+    def full_txn(self) -> Optional[Txn]:
+        """Reconstitute the complete txn if the merged partials cover the route."""
+        if self.partial_txn is None or self.route is None:
+            return None
+        return self.partial_txn.reconstitute_or_none(self.route)
+
+    def __repr__(self):
+        return f"CheckStatusOk({self.txn_id!r}, {self.save_status.name}, dur={self.durability.name})"
+
+
+class CheckStatus(TxnRequest):
+    """Interrogate replicas' knowledge of ``txn_id`` (CheckStatus.java).  With
+    ``include_info`` the reply carries the txn/deps/outcome payloads (the
+    reference's IncludeInfo.All)."""
+
+    __slots__ = ("include_info",)
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
+                 include_info: bool = True):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.include_info = include_info
+
+    @property
+    def type(self):
+        return MessageType.CHECK_STATUS_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id, include_info = self.txn_id, self.include_info
+
+        def map_fn(safe_store: SafeCommandStore):
+            command = safe_store.get_if_exists(txn_id)
+            if command is None:
+                return CheckStatusOk.empty(txn_id)
+            ok = CheckStatusOk.of(txn_id, command)
+            if not include_info:
+                ok.partial_txn = None
+                ok.partial_deps = None
+                ok.writes = None
+                ok.result = None
+            return ok
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
+            else:
+                node.reply(from_node, reply_context,
+                           result if result is not None else CheckStatusOk.empty(txn_id))
+
+        node.map_reduce_consume_local(self.scope, txn_id.epoch, txn_id.epoch,
+                                      map_fn, lambda a, b: a.merge(b)).begin(consume)
+
+    def __repr__(self):
+        return f"CheckStatus({self.txn_id!r})"
+
+
+# ---------------------------------------------------------------------------
+# local knowledge propagation (Propagate.java)
+# ---------------------------------------------------------------------------
+
+def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk) -> None:
+    """Apply a merged knowledge view to the local stores, upgrading the local
+    Known lattice: outcome -> apply; stable deps -> commit(STABLE); agreed
+    executeAt -> precommit; definition -> preaccept; invalidation propagates."""
+    route = merged.route
+    if route is None:
+        return
+    max_epoch = merged.execute_at.epoch if merged.execute_at is not None else txn_id.epoch
+
+    def for_store(safe_store: SafeCommandStore) -> None:
+        status = merged.save_status
+        if status is SaveStatus.INVALIDATED:
+            C.commit_invalidate(safe_store, txn_id)
+            return
+        if status.is_truncated:
+            return
+        if status.has_been(Status.PRE_APPLIED) and merged.writes is not None \
+                and merged.partial_deps is not None and merged.partial_txn is not None:
+            C.apply_(safe_store, txn_id, route, merged.execute_at, merged.partial_deps,
+                     merged.partial_txn, merged.writes, merged.result)
+            return
+        if status.has_been(Status.STABLE) and merged.partial_deps is not None \
+                and merged.partial_txn is not None:
+            C.commit(safe_store, txn_id, SaveStatus.STABLE, merged.promised, route,
+                     merged.partial_txn, merged.execute_at, merged.partial_deps)
+            return
+        if status.has_been(Status.PRE_COMMITTED) and merged.execute_at is not None:
+            C.precommit(safe_store, txn_id, merged.execute_at)
+            return
+        if status.has_been(Status.PRE_ACCEPTED) and merged.partial_txn is not None:
+            C.preaccept(safe_store, txn_id, merged.partial_txn, route)
+
+    node.for_each_local(route, txn_id.epoch, max_epoch, for_store)
+
+
+# ---------------------------------------------------------------------------
+# hint messages
+# ---------------------------------------------------------------------------
+
+class InformOfTxn(TxnRequest):
+    """Tell the home shard a txn exists so its progress log starts monitoring it
+    (InformOfTxnId.java)."""
+
+    __slots__ = ()
+
+    @property
+    def type(self):
+        return MessageType.INFORM_OF_TXN_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id, scope = self.txn_id, self.scope
+
+        def for_store(safe_store: SafeCommandStore) -> None:
+            command = safe_store.get_or_create(txn_id)
+            if command.route is None:
+                command.route = scope
+            safe_store.progress_log().unwitnessed(txn_id, scope.home_key, True)
+
+        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store)
+
+    def __repr__(self):
+        return f"InformOfTxn({self.txn_id!r})"
+
+
+class InformDurable(TxnRequest):
+    """Durability notice (InformDurable.java): mark the txn durable at the
+    given level so progress logs stand down."""
+
+    __slots__ = ("execute_at", "durability")
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
+                 execute_at: Optional[Timestamp], durability: Durability):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.execute_at = execute_at
+        self.durability = durability
+
+    @property
+    def type(self):
+        return MessageType.INFORM_DURABLE_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id, scope, execute_at, durability = \
+            self.txn_id, self.scope, self.execute_at, self.durability
+
+        def for_store(safe_store: SafeCommandStore) -> None:
+            C.set_durability(safe_store, txn_id, durability, scope, execute_at)
+
+        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store)
+
+    def __repr__(self):
+        return f"InformDurable({self.txn_id!r}, {self.durability.name})"

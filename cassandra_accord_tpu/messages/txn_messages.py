@@ -232,13 +232,17 @@ APPLY_OK = ApplyOk()
 # ---------------------------------------------------------------------------
 
 class PreAccept(TxnRequest):
-    __slots__ = ("partial_txn", "max_epoch")
+    __slots__ = ("partial_txn", "max_epoch", "route")
 
     def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
-                 partial_txn: PartialTxn, max_epoch: int):
+                 partial_txn: PartialTxn, max_epoch: int, route: Optional[Route] = None):
         super().__init__(txn_id, scope, wait_for_epoch)
         self.partial_txn = partial_txn
         self.max_epoch = max_epoch
+        # the FULL route: replicas store it so recovery/progress machinery can
+        # reconstitute the txn footprint (BeginRecovery.java route field /
+        # CheckStatus FoundRoute semantics)
+        self.route = route if route is not None else scope
 
     @property
     def type(self):
@@ -247,8 +251,10 @@ class PreAccept(TxnRequest):
     def process(self, node: "Node", from_node: int, reply_context) -> None:
         txn_id, partial_txn, scope = self.txn_id, self.partial_txn, self.scope
 
+        route = self.route
+
         def map_fn(safe_store: SafeCommandStore):
-            outcome = C.preaccept(safe_store, txn_id, partial_txn, scope)
+            outcome = C.preaccept(safe_store, txn_id, partial_txn, route)
             if outcome in (C.AcceptOutcome.REJECTED_BALLOT, C.AcceptOutcome.TRUNCATED):
                 return None
             command = safe_store.get_if_exists(txn_id)
@@ -282,15 +288,17 @@ class PreAccept(TxnRequest):
 # ---------------------------------------------------------------------------
 
 class Accept(TxnRequest):
-    __slots__ = ("ballot", "execute_at", "partial_deps", "keys")
+    __slots__ = ("ballot", "execute_at", "partial_deps", "keys", "route")
 
     def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int, ballot: Ballot,
-                 execute_at: Timestamp, keys, partial_deps: Deps):
+                 execute_at: Timestamp, keys, partial_deps: Deps,
+                 route: Optional[Route] = None):
         super().__init__(txn_id, scope, wait_for_epoch)
         self.ballot = ballot
         self.execute_at = execute_at
         self.keys = keys
         self.partial_deps = partial_deps
+        self.route = route if route is not None else scope
 
     @property
     def type(self):
@@ -299,9 +307,10 @@ class Accept(TxnRequest):
     def process(self, node: "Node", from_node: int, reply_context) -> None:
         txn_id, ballot, execute_at = self.txn_id, self.ballot, self.execute_at
         scope, keys, partial_deps = self.scope, self.keys, self.partial_deps
+        route = self.route
 
         def map_fn(safe_store: SafeCommandStore):
-            outcome = C.accept(safe_store, txn_id, ballot, scope, execute_at, partial_deps)
+            outcome = C.accept(safe_store, txn_id, ballot, route, execute_at, partial_deps)
             if outcome is C.AcceptOutcome.REJECTED_BALLOT:
                 command = safe_store.get_if_exists(txn_id)
                 return ("nack", command.promised)
@@ -342,12 +351,13 @@ class Accept(TxnRequest):
 
 class Commit(TxnRequest):
     __slots__ = ("kind_status", "ballot", "partial_txn", "execute_at", "partial_deps",
-                 "read")
+                 "read", "route")
 
     def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
                  kind_status: SaveStatus, execute_at: Timestamp,
                  partial_txn: Optional[PartialTxn], partial_deps: Deps,
-                 read: bool = False, ballot: Ballot = Ballot.ZERO):
+                 read: bool = False, ballot: Ballot = Ballot.ZERO,
+                 route: Optional[Route] = None):
         super().__init__(txn_id, scope, wait_for_epoch)
         self.kind_status = kind_status    # SaveStatus.COMMITTED or SaveStatus.STABLE
         self.ballot = ballot
@@ -355,6 +365,7 @@ class Commit(TxnRequest):
         self.execute_at = execute_at
         self.partial_deps = partial_deps
         self.read = read
+        self.route = route if route is not None else scope
 
     @property
     def type(self):
@@ -365,7 +376,7 @@ class Commit(TxnRequest):
         txn_id = self.txn_id
 
         def map_fn(safe_store: SafeCommandStore):
-            return C.commit(safe_store, txn_id, self.kind_status, self.ballot, self.scope,
+            return C.commit(safe_store, txn_id, self.kind_status, self.ballot, self.route,
                             self.partial_txn, self.execute_at, self.partial_deps)
 
         def consume(result, failure):
@@ -479,14 +490,16 @@ def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncCha
 # ---------------------------------------------------------------------------
 
 class Apply(TxnRequest):
-    __slots__ = ("kind", "execute_at", "partial_deps", "partial_txn", "writes", "result")
+    __slots__ = ("kind", "execute_at", "partial_deps", "partial_txn", "writes", "result",
+                 "route")
 
     MINIMAL = "minimal"
     MAXIMAL = "maximal"
 
     def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int, kind: str,
                  execute_at: Timestamp, partial_deps: Deps,
-                 partial_txn: Optional[PartialTxn], writes: Optional[Writes], result):
+                 partial_txn: Optional[PartialTxn], writes: Optional[Writes], result,
+                 route: Optional[Route] = None):
         super().__init__(txn_id, scope, wait_for_epoch)
         self.kind = kind
         self.execute_at = execute_at
@@ -494,6 +507,7 @@ class Apply(TxnRequest):
         self.partial_txn = partial_txn
         self.writes = writes
         self.result = result
+        self.route = route if route is not None else scope
 
     @property
     def type(self):
@@ -504,7 +518,7 @@ class Apply(TxnRequest):
         txn_id = self.txn_id
 
         def map_fn(safe_store: SafeCommandStore):
-            return C.apply_(safe_store, txn_id, self.scope, self.execute_at,
+            return C.apply_(safe_store, txn_id, self.route, self.execute_at,
                             self.partial_deps, self.partial_txn, self.writes, self.result)
 
         def consume(result, failure):

@@ -76,7 +76,8 @@ class Route:
             sliced = self.unseekables.intersection(ranges)
         else:
             sliced = self.unseekables.slice(ranges)
-        covering = ranges if self.full else self.covering.intersection(ranges)
+        covering = ranges if (self.full or self.covering is None) \
+            else self.covering.intersection(ranges)
         return Route(self.home_key, sliced, full=False, covering=covering)
 
     def union(self, other: "Route") -> "Route":

@@ -1,0 +1,201 @@
+"""Progress log liveness: automatic recovery of orphaned txns and resolution of
+blocked dependencies via CheckStatus / FetchData / Propagate.
+
+Parity target: accord.impl.SimpleProgressLog behavior — the home shard notices a
+txn making no progress and drives MaybeRecover; replicas blocked on a missing
+dependency fetch its outcome from peers and apply it locally.
+"""
+import pytest
+
+from cassandra_accord_tpu.harness.cluster import Cluster, LinkConfig
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.local.status import SaveStatus, Status
+from cassandra_accord_tpu.primitives.keys import IntKey, Range
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v):
+    return IntKey(v)
+
+
+class Deadable(LinkConfig):
+    """Once `dead` is set, that node sends nothing (requests or replies)."""
+
+    def __init__(self, rng):
+        super().__init__(rng)
+        self.dead = None
+
+    def action(self, from_node, to_node, message=None):
+        if self.dead is not None and from_node == self.dead:
+            return LinkConfig.DROP
+        return LinkConfig.DELIVER
+
+
+def make_cluster(seed=1, nodes=(1, 2, 3)):
+    shards = [Shard(Range(k(0), k(1000)), list(nodes))]
+    link = Deadable(RandomSource(seed * 13 + 5))
+    cluster = Cluster(Topology(1, shards), seed=seed, link_config=link,
+                      progress_log=True)
+    return cluster, link
+
+
+def statuses(cluster, txn_id, nodes):
+    out = {}
+    for n in nodes:
+        for store in cluster.nodes[n].command_stores.all_stores():
+            cmd = store.commands.get(txn_id)
+            if cmd is not None:
+                out[n] = cmd.save_status
+    return out
+
+
+def witnessed_txn_id(cluster, node_id):
+    ids = set()
+    for store in cluster.nodes[node_id].command_stores.all_stores():
+        ids.update(store.commands.keys())
+    return next(iter(ids)) if len(ids) == 1 else None
+
+
+def test_progress_log_settles_orphaned_preaccept():
+    """Coordinator dies right after PreAccept: surviving home-shard replicas must
+    settle the txn autonomously (invalidate or complete) — no client calls."""
+    cluster, link = make_cluster()
+    # let the preaccepts out, then the coordinator goes dark
+    txn = list_txn([], {k(5): "a"})
+    res = cluster.nodes[1].coordinate(txn)
+    cluster.run_until(lambda: witnessed_txn_id(cluster, 2) is not None,
+                      max_tasks=10_000)
+    txn_id = witnessed_txn_id(cluster, 2)
+    assert txn_id is not None
+    link.dead = 1
+
+    cluster.run_for(20.0)
+    st = statuses(cluster, txn_id, (2, 3))
+    assert st, "txn vanished"
+    terminal = {SaveStatus.APPLIED, SaveStatus.INVALIDATED}
+    assert all(s in terminal for s in st.values()), st
+    assert len(set(st.values())) == 1, f"replicas disagree: {st}"
+    # and the data converged with the decision
+    vals = {cluster.stores[n].get(k(5)) for n in (2, 3)}
+    assert len(vals) == 1
+
+
+def test_progress_log_completes_stable_txn():
+    """Coordinator dies after Stable reached replicas: progress log must finish
+    execution (the txn is durably decided, so it MUST apply, not invalidate)."""
+    class DropApply(LinkConfig):
+        armed = False
+
+        def action(self, from_node, to_node, message=None):
+            if self.armed and from_node == 1:
+                return LinkConfig.DROP
+            if from_node == 1 and type(message).__name__ == "Apply":
+                return LinkConfig.DROP
+            return LinkConfig.DELIVER
+
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    link = DropApply(RandomSource(77))
+    cluster = Cluster(Topology(1, shards), seed=3, link_config=link,
+                      progress_log=True)
+    txn = list_txn([], {k(7): "x"})
+    res = cluster.nodes[1].coordinate(txn)
+
+    def stable_on_replicas():
+        tid = witnessed_txn_id(cluster, 2)
+        if tid is None:
+            return False
+        st = statuses(cluster, tid, (2, 3))
+        return len(st) == 2 and all(s.has_been(Status.STABLE) for s in st.values())
+
+    cluster.run_until(stable_on_replicas, max_tasks=100_000)
+    assert stable_on_replicas()
+    txn_id = witnessed_txn_id(cluster, 2)
+    link.armed = True  # node 1 goes fully dark
+
+    cluster.run_for(20.0)
+    st = statuses(cluster, txn_id, (2, 3))
+    assert all(s is SaveStatus.APPLIED for s in st.values()), st
+    for n in (2, 3):
+        assert cluster.stores[n].get(k(7)) == ("x",)
+
+
+def test_blocked_dependency_fetched_and_applied():
+    """Apply of txn A never reaches node 3; a later conflicting txn B leaves node 3
+    blocked on A.  The blocking machinery must fetch A's outcome and unblock B."""
+    class DropApplyTo3(LinkConfig):
+        active = True
+
+        def action(self, from_node, to_node, message=None):
+            if self.active and to_node == 3 and type(message).__name__ == "Apply":
+                return LinkConfig.DROP
+            return LinkConfig.DELIVER
+
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    link = DropApplyTo3(RandomSource(31))
+    cluster = Cluster(Topology(1, shards), seed=9, link_config=link,
+                      progress_log=True)
+
+    ra = cluster.nodes[1].coordinate(list_txn([], {k(4): "A"}))
+    assert cluster.run_until(ra.is_done)
+    cluster.run_until_idle(max_tasks=50_000)
+    assert cluster.stores[3].get(k(4)) == ()  # apply dropped
+
+    link.active = False  # subsequent txns deliver everywhere
+    rb = cluster.nodes[2].coordinate(list_txn([], {k(4): "B"}))
+    assert cluster.run_until(rb.is_done)
+    cluster.run_for(20.0)
+    # node 3 must have resolved A through fetch/propagate and applied both
+    assert cluster.stores[3].get(k(4)) == ("A", "B")
+
+
+def test_progress_log_quiescent_on_healthy_cluster():
+    """No faults: the progress log must not interfere (no recoveries, data exact)."""
+    cluster, _link = make_cluster(seed=11)
+    results = [cluster.nodes[1 + (i % 3)].coordinate(list_txn([], {k(2): i}))
+               for i in range(6)]
+    assert cluster.run_until(lambda: all(r.is_done() for r in results))
+    cluster.run_for(10.0)
+    lists = [cluster.stores[n].get(k(2)) for n in cluster.nodes]
+    assert len(set(lists)) == 1
+    assert sorted(lists[0]) == list(range(6))
+    assert cluster.stats.get("BeginRecovery", 0) == 0, cluster.stats
+
+
+def test_undecided_blocking_dependency_gets_settled():
+    """Txn A's coordinator dies before reaching a quorum: A is pre-accepted on a
+    minority only.  A later txn B witnesses A as a dep and blocks on it on nodes
+    that never saw A.  The blocking machinery must drive A to a decision
+    (complete or invalidate) so B executes everywhere."""
+    class DropFromOne(LinkConfig):
+        active = False
+
+        def action(self, from_node, to_node, message=None):
+            if self.active and from_node == 1:
+                return LinkConfig.DROP
+            return LinkConfig.DELIVER
+
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    link = DropFromOne(RandomSource(17))
+    cluster = Cluster(Topology(1, shards), seed=21, link_config=link,
+                      progress_log=True)
+
+    # A pre-accepts ONLY on node 1 (its own store) — every outbound dropped
+    link.active = True
+    ra = cluster.nodes[1].coordinate(list_txn([], {k(6): "A"}))
+    cluster.run_until(lambda: any(
+        store.commands for store in cluster.nodes[1].command_stores.all_stores()),
+        max_tasks=10_000)
+    cluster.run_for(0.1)
+    link.active = False
+
+    # B from node 2: node 1's PreAccept reply includes A as a dependency
+    rb = cluster.nodes[2].coordinate(list_txn([], {k(6): "B"}))
+    assert cluster.run_until(rb.is_done, max_tasks=500_000)
+    cluster.run_for(30.0)
+
+    # every replica must converge: B applied everywhere; A either applied
+    # everywhere-or-invalidated everywhere
+    lists = {n: cluster.stores[n].get(k(6)) for n in cluster.nodes}
+    assert len(set(lists.values())) == 1, f"diverged: {lists}"
+    assert "B" in lists[1], lists
