@@ -107,6 +107,10 @@ class Write(abc.ABC):
     def apply(self, store: "DataStore", key, execute_at: "Timestamp") -> "AsyncChain":
         ...
 
+    def merge(self, other: "Write") -> "Write":
+        """Union of two per-shard slices of the same txn's write effect."""
+        return self
+
 
 class Read(abc.ABC):
     """Read hook (Read.java): executed replica-side at executeAt."""

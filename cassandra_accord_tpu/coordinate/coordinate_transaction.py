@@ -21,8 +21,8 @@ from typing import TYPE_CHECKING, Dict, List, Optional
 
 from ..messages.base import Callback, FailureReply, TxnRequest
 from ..messages.txn_messages import (
-    Accept, AcceptNack, AcceptOk, Apply, Commit, CommitNack, CommitOk, PreAccept,
-    PreAcceptNack, PreAcceptOk, ReadNack, ReadOk,
+    Accept, AcceptNack, AcceptOk, Apply, ApplyOk, Commit, CommitNack, CommitOk,
+    PreAccept, PreAcceptNack, PreAcceptOk, ReadNack, ReadOk,
 )
 from ..local.status import SaveStatus
 from ..primitives.deps import Deps
@@ -312,6 +312,10 @@ class _ExecuteTxn:
             informed = False
 
             def on_success(self, from_node: int, reply) -> None:
+                if not isinstance(reply, ApplyOk):
+                    # e.g. ReadNack("insufficient"): NOT a durable apply ack
+                    applied.record_failure(from_node)
+                    return
                 if not self.informed \
                         and applied.record_success(from_node) is RequestStatus.SUCCESS:
                     self.informed = True

@@ -11,8 +11,8 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..impl.list_store import ListResult, list_txn
-from ..primitives.keys import IntKey, Range
+from ..impl.list_store import ListResult, list_txn, range_read_txn
+from ..primitives.keys import IntKey, Range, Ranges
 from ..topology.topology import Shard, Topology
 from ..utils.random import RandomSource
 from .cluster import Cluster, LinkConfig
@@ -84,13 +84,27 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             op_id = state["submitted"]
             state["submitted"] += 1
             state["in_flight"] += 1
-            nkeys = rng.next_int(1, 4)
-            keys = sorted({key_for(i) for i in range(nkeys)})
-            kind = rng.pick(["read", "write", "rw", "rw"])
-            reads = keys if kind in ("read", "rw") else []
-            writes = {key: f"v{op_id}.{ki}" for ki, key in enumerate(keys)} \
-                if kind in ("write", "rw") else {}
-            txn = list_txn(reads, writes)
+            if rng.next_float() < 0.15:
+                # range query: 1-2 ranges, uniform or zipf sized
+                # (BurnTest.java:208-240)
+                nranges = rng.next_int(1, 3)
+                rngs = []
+                for _ in range(nranges):
+                    width = 1 + (rng.next_zipf(bound // 2) if zipf
+                                 else rng.next_int(bound // 2))
+                    start = rng.next_int(bound - 1)
+                    rngs.append(Range(IntKey(start),
+                                      IntKey(min(bound, start + width))))
+                txn = range_read_txn(Ranges.of(*rngs))
+                writes = {}
+            else:
+                nkeys = rng.next_int(1, 4)
+                keys = sorted({key_for(i) for i in range(nkeys)})
+                kind = rng.pick(["read", "write", "rw", "rw"])
+                reads = keys if kind in ("read", "rw") else []
+                writes = {key: f"v{op_id}.{ki}" for ki, key in enumerate(keys)} \
+                    if kind in ("write", "rw") else {}
+                txn = list_txn(reads, writes)
             coordinator = cluster.nodes[rng.pick(member_ids)]
             obs = verifier.begin(cluster.now_micros)
 

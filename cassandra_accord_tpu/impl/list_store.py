@@ -35,6 +35,10 @@ class ListStore(DataStore):
                 return
         insort(entries, (execute_at, value))
 
+    def keys_in(self, rng) -> List[Key]:
+        """All stored keys within a Range, sorted (range-read enumeration)."""
+        return sorted(k for k in self.data if rng.contains(k.to_routing()))
+
     def fetch(self, node, safe_store, ranges, sync_point, fetch_ranges):
         # in-memory bootstrap: nothing to stream in unit tests; report fetched
         fetch_ranges.fetched(ranges)
@@ -75,6 +79,27 @@ class ListRead(Read):
         return ListRead(self._keys.union(other._keys))
 
 
+class ListRangeRead(Read):
+    """Range-domain read: reads every stored key inside the ranges
+    (the reference's range queries, BurnTest.java:208-240 / ListRead over ranges)."""
+
+    def __init__(self, ranges: Ranges):
+        self._ranges = ranges
+
+    def keys(self):
+        return self._ranges
+
+    def read(self, rng, safe_store, execute_at, data_store) -> au.AsyncChain:
+        entries = {key: data_store.get(key) for key in data_store.keys_in(rng)}
+        return au.done(ListData(entries))
+
+    def slice(self, ranges: Ranges) -> "ListRangeRead":
+        return ListRangeRead(self._ranges.intersection(ranges))
+
+    def merge(self, other: "Read") -> "ListRangeRead":
+        return ListRangeRead(self._ranges.union(other._ranges))
+
+
 class ListWrite(Write):
     """Computed appends: key -> value."""
 
@@ -85,6 +110,11 @@ class ListWrite(Write):
         if key in self.appends:
             store.append(key, execute_at, self.appends[key])
         return au.done(None)
+
+    def merge(self, other: "ListWrite") -> "ListWrite":
+        merged = dict(self.appends)
+        merged.update(other.appends)
+        return ListWrite(merged)
 
 
 class ListUpdate(Update):
@@ -140,3 +170,9 @@ def list_txn(keys_read: List[Key], appends: Dict[Key, object]):
     read = ListRead(Keys.of(keys_read))
     update = ListUpdate(appends) if appends else None
     return Txn.of(all_keys, read, update, ListQuery())
+
+
+def range_read_txn(ranges: Ranges):
+    """Build a range-domain read Txn over ``ranges`` (reference range queries)."""
+    from ..primitives.txn import Txn
+    return Txn.of(ranges, ListRangeRead(ranges), None, ListQuery())

@@ -38,7 +38,9 @@ class Progress(enum.Enum):
     DONE = 3
 
 
-class _CoordinateState:
+class _MonitorState:
+    """Per-txn monitoring record, used both for home-shard coordination
+    monitoring and for blocked-dependency resolution."""
     __slots__ = ("txn_id", "route", "progress", "token")
 
     def __init__(self, txn_id: TxnId, route: Route):
@@ -48,14 +50,8 @@ class _CoordinateState:
         self.token = None
 
 
-class _BlockingState:
-    __slots__ = ("txn_id", "route", "progress", "token")
-
-    def __init__(self, txn_id: TxnId, route: Route):
-        self.txn_id = txn_id
-        self.route = route
-        self.progress = Progress.EXPECTED
-        self.token = None
+_CoordinateState = _MonitorState
+_BlockingState = _MonitorState
 
 
 class _NonHomeState:

@@ -33,13 +33,15 @@ class CheckStatusOk(Reply):
 
     __slots__ = ("txn_id", "save_status", "promised", "accepted", "execute_at",
                  "durability", "route", "partial_txn", "partial_deps", "writes",
-                 "result")
+                 "result", "stable_for", "applied_for")
 
     def __init__(self, txn_id: TxnId, save_status: SaveStatus, promised: Ballot,
                  accepted: Ballot, execute_at: Optional[Timestamp],
                  durability: Durability, route: Optional[Route],
                  partial_txn: Optional[PartialTxn], partial_deps: Optional[Deps],
-                 writes: Optional[Writes], result):
+                 writes: Optional[Writes], result,
+                 stable_for=None, applied_for=None):
+        from ..primitives.keys import Ranges
         self.txn_id = txn_id
         self.save_status = save_status
         self.promised = promised
@@ -51,17 +53,29 @@ class CheckStatusOk(Reply):
         self.partial_deps = partial_deps
         self.writes = writes
         self.result = result
+        # coverage of this knowledge (the reference's FoundKnownMap / Known
+        # sufficiency, CheckStatus.java): the ranges for which the carried deps
+        # (>= STABLE) and writes (>= PRE_APPLIED) slices are known-complete
+        self.stable_for = stable_for if stable_for is not None else Ranges.EMPTY
+        self.applied_for = applied_for if applied_for is not None else Ranges.EMPTY
 
     @property
     def type(self):
         return MessageType.CHECK_STATUS_RSP
 
     @staticmethod
-    def of(txn_id: TxnId, command) -> "CheckStatusOk":
+    def of(txn_id: TxnId, command, local_ranges=None) -> "CheckStatusOk":
+        from ..primitives.keys import Ranges
+        local = local_ranges if local_ranges is not None else Ranges.EMPTY
+        stable_for = local if command.save_status.has_been(Status.STABLE) \
+            and not command.save_status.is_truncated else Ranges.EMPTY
+        applied_for = local if command.save_status.has_been(Status.PRE_APPLIED) \
+            and not command.save_status.is_truncated else Ranges.EMPTY
         return CheckStatusOk(txn_id, command.save_status, command.promised,
                              command.accepted_or_committed, command.execute_at,
                              command.durability, command.route, command.partial_txn,
-                             command.partial_deps, command.writes, command.result)
+                             command.partial_deps, command.writes, command.result,
+                             stable_for=stable_for, applied_for=applied_for)
 
     @staticmethod
     def empty(txn_id: TxnId) -> "CheckStatusOk":
@@ -87,16 +101,27 @@ class CheckStatusOk(Reply):
         partial_deps = a.partial_deps
         if partial_deps is None:
             partial_deps = b.partial_deps
-        elif b.partial_deps is not None and a.save_status.ordinal == b.save_status.ordinal:
-            # same knowledge tier: deps slices from different shards merge
+        elif b.partial_deps is not None and \
+                ((a.save_status.has_been(Status.STABLE)
+                  and b.save_status.has_been(Status.STABLE))
+                 or a.save_status.ordinal == b.save_status.ordinal):
+            # same knowledge tier (or both stable): deps slices from different
+            # shards of the same decision merge
             partial_deps = partial_deps.with_merged(b.partial_deps)
+        writes = a.writes
+        if writes is None:
+            writes = b.writes
+        elif b.writes is not None:
+            writes = writes.merge(b.writes)
         return CheckStatusOk(
             a.txn_id, a.save_status, a.promised.merge_max(b.promised),
             a.accepted.merge_max(b.accepted),
             a.execute_at if a.execute_at is not None else b.execute_at,
             max(a.durability, b.durability), route, partial_txn, partial_deps,
-            a.writes if a.writes is not None else b.writes,
-            a.result if a.result is not None else b.result)
+            writes,
+            a.result if a.result is not None else b.result,
+            stable_for=a.stable_for.union(b.stable_for),
+            applied_for=a.applied_for.union(b.applied_for))
 
     def full_txn(self) -> Optional[Txn]:
         """Reconstitute the complete txn if the merged partials cover the route."""
@@ -131,7 +156,7 @@ class CheckStatus(TxnRequest):
             command = safe_store.get_if_exists(txn_id)
             if command is None:
                 return CheckStatusOk.empty(txn_id)
-            ok = CheckStatusOk.of(txn_id, command)
+            ok = CheckStatusOk.of(txn_id, command, safe_store.current_ranges())
             if not include_info:
                 ok.partial_txn = None
                 ok.partial_deps = None
@@ -173,13 +198,20 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk) -> N
             return
         if status.is_truncated:
             return
+        # gate each tier on the merged knowledge actually covering THIS store's
+        # slice of the route (the reference's Known.sufficientFor per-store gate,
+        # Propagate.java): deps/writes slices fetched from a subset of shards
+        # must not be applied to stores they don't cover.
+        local_parts = route.participants().slice(safe_store.current_ranges())
         if status.has_been(Status.PRE_APPLIED) and merged.writes is not None \
-                and merged.partial_deps is not None and merged.partial_txn is not None:
+                and merged.partial_deps is not None and merged.partial_txn is not None \
+                and merged.applied_for.contains_all(local_parts):
             C.apply_(safe_store, txn_id, route, merged.execute_at, merged.partial_deps,
                      merged.partial_txn, merged.writes, merged.result)
             return
         if status.has_been(Status.STABLE) and merged.partial_deps is not None \
-                and merged.partial_txn is not None:
+                and merged.partial_txn is not None \
+                and merged.stable_for.contains_all(local_parts):
             C.commit(safe_store, txn_id, SaveStatus.STABLE, merged.promised, route,
                      merged.partial_txn, merged.execute_at, merged.partial_deps)
             return
@@ -213,7 +245,10 @@ class InformOfTxn(TxnRequest):
             command = safe_store.get_or_create(txn_id)
             if command.route is None:
                 command.route = scope
-            safe_store.progress_log().unwitnessed(txn_id, scope.home_key, True)
+            # only the store owning the home key takes on coordination-progress
+            # monitoring (the reference's progress-shard discipline)
+            progress_shard = safe_store.current_ranges().contains(scope.home_key)
+            safe_store.progress_log().unwitnessed(txn_id, scope.home_key, progress_shard)
 
         node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store)
 

@@ -467,9 +467,10 @@ def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncCha
         if command.save_status.ordinal >= SaveStatus.READY_TO_EXECUTE.ordinal \
                 and not command.save_status.is_truncated:
             ranges = s.store.current_ranges()
-            read_keys = [k for k in command.partial_txn.keys
-                         if ranges.contains(k.to_routing() if hasattr(k, "to_routing") else k)] \
-                if not isinstance(command.partial_txn.keys, Ranges) else command.partial_txn.keys
+            read_keys = command.partial_txn.keys.intersection(ranges) \
+                if isinstance(command.partial_txn.keys, Ranges) \
+                else [k for k in command.partial_txn.keys
+                      if ranges.contains(k.to_routing() if hasattr(k, "to_routing") else k)]
             command.partial_txn.read_chain(s, command.execute_at, read_keys).begin(
                 lambda data, f: result.set_failure(f) if f is not None
                 else result.set_success(data))

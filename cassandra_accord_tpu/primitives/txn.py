@@ -191,5 +191,16 @@ class Writes:
             return Writes(self.txn_id, self.execute_at, self.keys.intersection(ranges), self.write)
         return Writes(self.txn_id, self.execute_at, self.keys.slice(ranges), self.write)
 
+    def merge(self, other: Optional["Writes"]) -> "Writes":
+        """Union of two per-shard slices of the same txn's writes."""
+        if other is None or other.write is None:
+            return self
+        if self.write is None:
+            return other
+        keys = self.keys.union(other.keys)
+        write = self.write if self.write is other.write \
+            else self.write.merge(other.write)
+        return Writes(self.txn_id, self.execute_at, keys, write)
+
     def __repr__(self) -> str:
         return f"Writes({self.txn_id!r}@{self.execute_at!r}, {self.keys!r})"
