@@ -108,7 +108,13 @@ class Write(abc.ABC):
         ...
 
     def merge(self, other: "Write") -> "Write":
-        """Union of two per-shard slices of the same txn's write effect."""
+        """Union of two per-shard slices of the same txn's write effect.
+        Implementations whose slices can differ MUST override; silently keeping
+        one slice would lose the other's effects."""
+        if other is not self and other is not None:
+            raise NotImplementedError(
+                f"{type(self).__name__}.merge: per-shard write slices cannot be "
+                "combined without an implementation-specific merge")
         return self
 
 

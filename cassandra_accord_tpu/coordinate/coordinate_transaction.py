@@ -302,27 +302,45 @@ class _ExecuteTxn:
         txn_result = self.txn.result(self.txn_id, self.execute_at, self.data)
         writes = self.txn.execute(self.txn_id, self.execute_at, self.data)
         self.result.set_success(txn_result)
+        # sync points always apply Maximal so any replica (e.g. one that never
+        # witnessed it) can apply without prior state (CoordinationAdapter:214-264)
+        apply_kind = Apply.MAXIMAL if self.txn_id.kind.is_sync_point else Apply.MINIMAL
+        self.send_applies(writes, txn_result, apply_kind,
+                          on_quorum_applied=self.inform_durable)
 
-        # track Apply acks: at a quorum of every shard the outcome is durable —
-        # broadcast InformDurable so progress logs stand down (PersistTxn.java)
+    def send_applies(self, writes, txn_result, apply_kind: str,
+                     on_quorum_applied=None, on_quorum_impossible=None) -> None:
+        """Broadcast Apply to every replica; fire ``on_quorum_applied`` once a
+        quorum of every shard has acked (PersistTxn.java; progress logs then
+        stand down via InformDurable), or ``on_quorum_impossible`` once some
+        shard can no longer reach an apply quorum.  MAXIMAL applies carry the
+        full txn definition so any replica can apply without prior state."""
         applied = AppliedTracker(self.topologies)
         this = self
 
         class ApplyCallback(Callback):
             informed = False
 
+            def _failed(self, from_node: int) -> None:
+                if applied.record_failure(from_node) is RequestStatus.FAILED \
+                        and not self.informed:
+                    self.informed = True
+                    if on_quorum_impossible is not None:
+                        on_quorum_impossible()
+
             def on_success(self, from_node: int, reply) -> None:
                 if not isinstance(reply, ApplyOk):
                     # e.g. ReadNack("insufficient"): NOT a durable apply ack
-                    applied.record_failure(from_node)
+                    self._failed(from_node)
                     return
                 if not self.informed \
                         and applied.record_success(from_node) is RequestStatus.SUCCESS:
                     self.informed = True
-                    this.inform_durable()
+                    if on_quorum_applied is not None:
+                        on_quorum_applied()
 
             def on_failure(self, from_node: int, failure: BaseException) -> None:
-                applied.record_failure(from_node)
+                self._failed(from_node)
 
         callback = ApplyCallback()
         for to in self.topologies.nodes():
@@ -331,10 +349,12 @@ class _ExecuteTxn:
                 continue
             wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
             ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
+            partial_txn = self.txn.slice(ranges, include_query=False) \
+                if apply_kind == Apply.MAXIMAL else None
             self.node.send(to, Apply(
-                self.txn_id, scope, wait_for, Apply.MINIMAL, self.execute_at,
-                self.deps.slice(ranges), None, writes.slice(ranges), txn_result,
-                route=self.route), callback)
+                self.txn_id, scope, wait_for, apply_kind, self.execute_at,
+                self.deps.slice(ranges), partial_txn, writes.slice(ranges),
+                txn_result, route=self.route), callback)
 
     def inform_durable(self) -> None:
         from ..local.status import Durability

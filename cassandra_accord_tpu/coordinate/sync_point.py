@@ -1,0 +1,127 @@
+"""Sync point coordination.
+
+Capability parity with ``accord.coordinate`` CoordinateSyncPoint / ProposeSyncPoint /
+ExecuteSyncPoint (CoordinateSyncPoint.java:58-140, CoordinationAdapter.java:214-264):
+a sync point is an empty transaction (kind SyncPoint or ExclusiveSyncPoint) coordinated
+through the standard PreAccept/Accept/Stable pipeline whose *execution* is pure
+dependency-wait — once applied, every transaction in its dependency set is decided
+(and, for a quorum-applied sync point, durably applied at a quorum per shard).
+
+- inclusive, async:   resolves with the SyncPoint handle once stable (deps known);
+                      applies proceed in the background (CoordinateSyncPoint.inclusive).
+- inclusive, blocking: resolves once a quorum of every shard has Applied.
+- exclusive:          kind ExclusiveSyncPoint — witnesses everything before it and is
+                      witnessed by everything after; used by bootstrap, epoch closure
+                      and shard-durability rounds.  Always quorum-applied, and notifies
+                      the epoch-closure hook (CoordinationAdapter.java:214-264).
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Union
+
+from ..local.status import SaveStatus
+from ..primitives.keys import Keys, Ranges
+from ..primitives.sync_point import SyncPoint
+from ..primitives.timestamp import Ballot, TxnId, TxnKind
+from ..primitives.txn import Txn
+from ..utils import async_ as au
+from .coordinate_transaction import _CoordinateTransaction, _ExecuteTxn
+from ..messages.txn_messages import Apply
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+Seekables = Union[Keys, Ranges]
+
+
+def coordinate_inclusive(node: "Node", seekables: Seekables,
+                         blocking: bool = False) -> au.AsyncResult:
+    """Coordinate an inclusive sync point over ``seekables``
+    (CoordinateSyncPoint.inclusive / inclusiveAndAwaitQuorum)."""
+    return _coordinate(node, TxnKind.SYNC_POINT, seekables, blocking)
+
+
+def coordinate_exclusive(node: "Node", ranges: Ranges,
+                         blocking: bool = True) -> au.AsyncResult:
+    """Coordinate an exclusive sync point over ``ranges``
+    (CoordinateSyncPoint.exclusive; used by Bootstrap and durability rounds)."""
+    return _coordinate(node, TxnKind.EXCLUSIVE_SYNC_POINT, ranges, blocking=blocking)
+
+
+def _coordinate(node: "Node", kind: TxnKind, seekables: Seekables,
+                blocking: bool) -> au.AsyncResult:
+    txn = Txn.empty(kind, seekables)
+    txn_id = node.next_txn_id(kind, txn.domain)
+    result = au.settable()
+
+    def start(_v, f):
+        if f is not None:
+            result.set_failure(f)
+            return
+        route = node.compute_route(txn)
+        _CoordinateSyncPoint(node, txn_id, txn, route, result, blocking).start()
+
+    node.with_epoch(txn_id.epoch).begin(start)
+    return result
+
+
+class _CoordinateSyncPoint(_CoordinateTransaction):
+    """Drives the standard pipeline but executes as a sync point."""
+
+    def __init__(self, node: "Node", txn_id: TxnId, txn: Txn, route, result,
+                 blocking: bool):
+        super().__init__(node, txn_id, txn, route, result)
+        self.blocking = blocking
+
+    def execute(self, path: str, execute_at, deps) -> None:
+        _ExecuteSyncPoint(self.node, self.txn_id, self.txn, self.route,
+                          self.topologies, SaveStatus.STABLE, execute_at, deps,
+                          self.result, require_stable_quorum=False,
+                          blocking=self.blocking).start()
+
+    def stabilise_and_execute(self, execute_at, deps, ballot=Ballot.ZERO) -> None:
+        _ExecuteSyncPoint(self.node, self.txn_id, self.txn, self.route,
+                          self.topologies, SaveStatus.STABLE, execute_at, deps,
+                          self.result, require_stable_quorum=True, ballot=ballot,
+                          blocking=self.blocking).start()
+
+
+class _ExecuteSyncPoint(_ExecuteTxn):
+    """ExecuteSyncPoint.java: same Stable round, but the result is the SyncPoint
+    handle, applies are MAXIMAL (any replica can apply without prior state), and
+    a blocking sync point resolves only once a quorum of every shard applied."""
+
+    def __init__(self, *args, blocking: bool = False, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.blocking = blocking
+
+    def persist(self) -> None:
+        sync_point = SyncPoint(self.txn_id, self.route, self.deps)
+        txn_result = self.txn.result(self.txn_id, self.execute_at, self.data)
+        writes = self.txn.execute(self.txn_id, self.execute_at, self.data)
+        if not self.blocking:
+            self.result.set_success(sync_point)
+
+        def on_applied():
+            if self.blocking and not self.result.is_done():
+                self.result.set_success(sync_point)
+            self.on_quorum_applied(sync_point)
+            self.inform_durable()
+
+        def on_impossible():
+            if self.blocking and not self.result.is_done():
+                from .errors import Exhausted
+                self.result.set_failure(Exhausted(self.txn_id, "apply quorum"))
+
+        self.send_applies(writes, txn_result, Apply.MAXIMAL,
+                          on_quorum_applied=on_applied,
+                          on_quorum_impossible=on_impossible)
+
+    def on_quorum_applied(self, sync_point: SyncPoint) -> None:
+        """Hook: exclusive sync points mark epochs closed / redundancy bounds
+        here (wired by durability scheduling and bootstrap)."""
+        if self.txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT:
+            participants = self.route.participants()
+            if isinstance(participants, Ranges):
+                self.node.on_exclusive_sync_point_applied(
+                    self.txn_id, participants)

@@ -46,6 +46,7 @@ class Node(ConfigurationService.Listener):
         self.topology = TopologyManager(node_id)
         self.command_stores = CommandStores(self, num_shards, executor_factory)
         self._progress_log_factory = progress_log_factory
+        self._exclusive_sync_point_listeners: List[Callable] = []
         self._last_hlc = 0
         config_service.register_listener(self)
         topo = config_service.current_topology()
@@ -130,6 +131,34 @@ class Node(ConfigurationService.Listener):
             lambda _v, f: result.set_failure(f) if f is not None
             else do_recover(self, txn_id, txn, route, result))
         return result
+
+    def barrier(self, seekables, min_epoch: Optional[int] = None,
+                barrier_type=None) -> au.AsyncResult:
+        """Coordinate a barrier over keys/ranges (Barrier.java)."""
+        from ..api.interfaces import BarrierType
+        from ..coordinate.barrier import barrier as do_barrier
+        if barrier_type is None:
+            barrier_type = BarrierType.GLOBAL_SYNC
+        epoch = min_epoch if min_epoch is not None else self.epoch()
+        return do_barrier(self, seekables, epoch, barrier_type)
+
+    def sync_point(self, seekables, exclusive: bool = False,
+                   blocking: bool = True) -> au.AsyncResult:
+        """Coordinate a sync point (CoordinateSyncPoint.java)."""
+        from ..coordinate import sync_point as sp
+        if exclusive:
+            return sp.coordinate_exclusive(self, seekables, blocking=blocking)
+        return sp.coordinate_inclusive(self, seekables, blocking=blocking)
+
+    def on_exclusive_sync_point_applied(self, txn_id: TxnId, ranges: Ranges) -> None:
+        """Hook fired when an exclusive sync point this node coordinated reaches
+        quorum-applied: everything before it on ``ranges`` is shard-durable.
+        Wired into the durability/GC machinery (RedundantBefore/DurableBefore)."""
+        for listener in list(self._exclusive_sync_point_listeners):
+            listener(txn_id, ranges)
+
+    def add_exclusive_sync_point_listener(self, listener) -> None:
+        self._exclusive_sync_point_listeners.append(listener)
 
     # -- message dispatch (Node.java:705, :425-527) ---------------------------
     def receive(self, request: "Request", from_node: int, reply_context) -> None:

@@ -158,10 +158,14 @@ class CheckStatus(TxnRequest):
                 return CheckStatusOk.empty(txn_id)
             ok = CheckStatusOk.of(txn_id, command, safe_store.current_ranges())
             if not include_info:
+                from ..primitives.keys import Ranges
                 ok.partial_txn = None
                 ok.partial_deps = None
                 ok.writes = None
                 ok.result = None
+                # coverage claims travel WITH the payloads they describe
+                ok.stable_for = Ranges.EMPTY
+                ok.applied_for = Ranges.EMPTY
             return ok
 
         def consume(result, failure):

@@ -535,3 +535,80 @@ class Apply(TxnRequest):
 
     def __repr__(self):
         return f"Apply[{self.kind}]({self.txn_id!r})"
+
+
+# ---------------------------------------------------------------------------
+# WaitUntilApplied (WaitUntilApplied.java): blocking wait used by sync-point
+# execution, recovery, and bootstrap streaming — replies once the txn has
+# Applied in every intersecting local store (or nacks if invalidated).
+# ---------------------------------------------------------------------------
+
+class WaitUntilApplied(TxnRequest):
+    __slots__ = ("execute_at_hint",)
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
+                 execute_at_hint: Optional[Timestamp] = None):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.execute_at_hint = execute_at_hint
+
+    @property
+    def type(self):
+        return MessageType.WAIT_UNTIL_APPLIED_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id = self.txn_id
+        max_epoch = self.execute_at_hint.epoch if self.execute_at_hint is not None \
+            else txn_id.epoch
+
+        def consume(outcome, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(
+                    from_node, reply_context, failure)
+            elif outcome == "nack":
+                node.reply(from_node, reply_context, ReadNack("invalidated"))
+            else:
+                node.reply(from_node, reply_context, APPLY_OK)
+
+        await_applied_local(node, txn_id, self.scope, txn_id.epoch,
+                            max_epoch).begin(consume)
+
+    def __repr__(self):
+        return f"WaitUntilApplied({self.txn_id!r})"
+
+
+def await_applied_local(node: "Node", txn_id: TxnId, unseekables,
+                        min_epoch: int, max_epoch: int) -> au.AsyncChain:
+    """Chain resolving "ok"/"nack" once ``txn_id`` is Applied (or truncated /
+    invalidated) in every intersecting LOCAL store.  Shared by WaitUntilApplied
+    and local barriers."""
+    stores = node.command_stores.intersecting_stores(unseekables, min_epoch,
+                                                     max_epoch)
+    if not stores:
+        return au.done("ok")
+    chains = [store.submit(lambda s: await_applied(s, txn_id))
+              .flat_map(lambda c: c) for store in stores]
+    return au.all_of(chains).map(
+        lambda results: "nack" if any(r == "nack" for r in results) else "ok")
+
+
+def await_applied(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncChain:
+    """Chain resolving once ``txn_id`` is Applied (or truncated) in this store."""
+    result = au.settable()
+
+    def check(s: SafeCommandStore, command) -> bool:
+        if command.save_status is SaveStatus.INVALIDATED:
+            result.set_success("nack")
+            return True
+        if command.save_status.ordinal >= SaveStatus.APPLIED.ordinal \
+                or command.save_status.is_truncated:
+            result.set_success("ok")
+            return True
+        return False
+
+    command = safe_store.get_or_create(txn_id)
+    if not check(safe_store, command):
+        def listener(s: SafeCommandStore, cmd):
+            if check(s, cmd):
+                s.remove_transient_listener(txn_id, listener)
+        safe_store.add_transient_listener(txn_id, listener)
+    return result.to_chain()

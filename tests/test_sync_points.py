@@ -1,0 +1,163 @@
+"""Sync points and barriers on the simulated cluster.
+
+Parity targets: CoordinateSyncPoint inclusive/exclusive (CoordinateSyncPoint.java:58-140),
+Barrier local/global (Barrier.java:56-313), WaitUntilApplied.
+"""
+from cassandra_accord_tpu.api.interfaces import BarrierType
+from cassandra_accord_tpu.harness.cluster import Cluster
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.local.status import SaveStatus
+from cassandra_accord_tpu.primitives.keys import IntKey, Keys, Range, Ranges
+from cassandra_accord_tpu.primitives.sync_point import SyncPoint
+from cassandra_accord_tpu.primitives.timestamp import TxnKind
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+
+
+def k(v):
+    return IntKey(v)
+
+
+def make_cluster(seed=1, nodes=(1, 2, 3), shards=None, **kw):
+    if shards is None:
+        shards = [Shard(Range(k(0), k(1000)), list(nodes))]
+    return Cluster(Topology(1, shards), seed=seed, **kw)
+
+
+def submit_write(cluster, node_id, appends):
+    txn = list_txn([], {k(key): v for key, v in appends.items()})
+    return cluster.nodes[node_id].coordinate(txn)
+
+
+def all_ranges():
+    return Ranges.of(Range(k(0), k(1000)))
+
+
+def test_inclusive_sync_point_blocking_waits_for_deps():
+    cluster = make_cluster()
+    w = submit_write(cluster, 1, {5: "a"})
+    res = cluster.nodes[2].sync_point(all_ranges(), blocking=True)
+    assert cluster.run_until(res.is_done)
+    sp = res.value
+    assert isinstance(sp, SyncPoint)
+    assert sp.txn_id.kind is TxnKind.SYNC_POINT
+    # the write it syncs over must have been applied at a quorum: on this
+    # cluster the write is also resolved
+    assert w.is_done()
+    cluster.run_until_idle()
+    # the sync point itself applied on replicas
+    for n in cluster.nodes:
+        node = cluster.nodes[n]
+        found = False
+        for store in node.command_stores.all_stores():
+            cmd = store.commands.get(sp.txn_id)
+            if cmd is not None and cmd.save_status.ordinal >= SaveStatus.APPLIED.ordinal:
+                found = True
+        assert found, f"sync point not applied on node {n}"
+
+
+def test_inclusive_sync_point_witnesses_prior_write():
+    cluster = make_cluster(seed=5)
+    w = submit_write(cluster, 1, {7: "x"})
+    assert cluster.run_until(w.is_done)
+    res = cluster.nodes[3].sync_point(all_ranges(), blocking=True)
+    assert cluster.run_until(res.is_done)
+    # deps of the sync point must include the applied write's txn id (it is a
+    # conflicting earlier txn on a covered key)
+    dep_ids = set(res.value.deps.txn_ids())
+    assert any(t.kind is TxnKind.WRITE for t in dep_ids), dep_ids
+
+
+def test_exclusive_sync_point():
+    cluster = make_cluster(seed=9)
+    submit_write(cluster, 1, {3: "z"})
+    fired = []
+    cluster.nodes[2].add_exclusive_sync_point_listener(
+        lambda txn_id, ranges: fired.append((txn_id, ranges)))
+    res = cluster.nodes[2].sync_point(all_ranges(), exclusive=True)
+    assert cluster.run_until(res.is_done)
+    assert res.value.txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT
+    cluster.run_until_idle()
+    assert fired and fired[0][0] == res.value.txn_id
+
+
+def test_exclusive_sync_point_witnesses_all_earlier_txns():
+    """Witness-matrix parity (Txn.java:221-262): ExclusiveSyncPoint witnesses
+    AnyGloballyVisible — both earlier reads and earlier writes appear in its
+    deps.  (A later Write does NOT witness the XSP: Write witnesses RsOrWs.)"""
+    cluster = make_cluster(seed=13)
+    w = submit_write(cluster, 1, {500: "pre"})
+    assert cluster.run_until(w.is_done)
+    r = cluster.nodes[2].coordinate(list_txn([k(600)], {}))
+    assert cluster.run_until(r.is_done)
+    cluster.run_until_idle()
+    res = cluster.nodes[1].sync_point(all_ranges(), exclusive=True)
+    assert cluster.run_until(res.is_done)
+    dep_kinds = {t.kind for t in res.value.deps.txn_ids()}
+    assert TxnKind.WRITE in dep_kinds, res.value.deps
+    assert TxnKind.READ in dep_kinds, res.value.deps
+
+
+def test_global_sync_barrier():
+    cluster = make_cluster(seed=17)
+    submit_write(cluster, 1, {9: "b"})
+    res = cluster.nodes[2].barrier(all_ranges(), barrier_type=BarrierType.GLOBAL_SYNC)
+    assert cluster.run_until(res.is_done)
+    assert isinstance(res.value, SyncPoint)
+
+
+def test_global_async_barrier_resolves_before_applies_finish():
+    cluster = make_cluster(seed=19)
+    res = cluster.nodes[1].barrier(all_ranges(), barrier_type=BarrierType.GLOBAL_ASYNC)
+    assert cluster.run_until(res.is_done)
+    assert isinstance(res.value, SyncPoint)
+    cluster.run_until_idle()
+
+
+def test_local_barrier_fast_path_uses_existing_applied_txn():
+    cluster = make_cluster(seed=23)
+    w = submit_write(cluster, 1, {11: "c"})
+    assert cluster.run_until(w.is_done)
+    cluster.run_until_idle()
+    # barrier over just the written key: the applied write covers it
+    res = cluster.nodes[1].barrier(Keys.of([k(11)]), min_epoch=1,
+                                   barrier_type=BarrierType.LOCAL)
+    assert cluster.run_until(res.is_done)
+    assert res.value is not None
+
+
+def test_local_barrier_slow_path_coordinates_sync_point():
+    cluster = make_cluster(seed=29)
+    res = cluster.nodes[2].barrier(Keys.of([k(77)]), min_epoch=1,
+                                   barrier_type=BarrierType.LOCAL)
+    assert cluster.run_until(res.is_done)
+    assert isinstance(res.value, SyncPoint)
+
+
+def test_wait_until_applied_message():
+    from cassandra_accord_tpu.messages.txn_messages import (ApplyOk,
+                                                            WaitUntilApplied)
+    cluster = make_cluster(seed=31)
+    w = submit_write(cluster, 1, {13: "d"})
+    assert cluster.run_until(w.is_done)
+    cluster.run_until_idle()
+    # find the applied write's id + route on node 2
+    node = cluster.nodes[2]
+    target = None
+    for store in node.command_stores.all_stores():
+        for txn_id, cmd in store.commands.items():
+            if txn_id.kind is TxnKind.WRITE and cmd.route is not None:
+                target = (txn_id, cmd.route)
+    assert target is not None
+    txn_id, route = target
+    replies = []
+
+    class _Cb:
+        def on_success(self, from_node, reply):
+            replies.append(reply)
+
+        def on_failure(self, from_node, failure):
+            replies.append(failure)
+
+    cluster.nodes[1].send(2, WaitUntilApplied(txn_id, route, 1), _Cb())
+    assert cluster.run_until(lambda: bool(replies))
+    assert isinstance(replies[0], ApplyOk), replies
