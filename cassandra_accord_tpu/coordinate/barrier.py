@@ -16,30 +16,38 @@ path, mirroring Barrier.java's BarrierTxn result).
 """
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Union
+from typing import TYPE_CHECKING
 
 from ..api.interfaces import BarrierType
 from ..local.status import SaveStatus
-from ..primitives.keys import Keys, Ranges
+from ..primitives.keys import Ranges
 from ..primitives.timestamp import TxnId
+from ..primitives.txn import Seekables
 from ..utils import async_ as au
 from . import sync_point as sp
 
 if TYPE_CHECKING:
     from ..local.node import Node
 
-Seekables = Union[Keys, Ranges]
-
 
 def barrier(node: "Node", seekables: Seekables, min_epoch: int,
             barrier_type: BarrierType) -> au.AsyncResult:
-    """Coordinate a barrier (Barrier.barrier)."""
+    """Coordinate a barrier (Barrier.barrier).  Awaits ``min_epoch`` before
+    coordinating so the sync point's TxnId (and hence its dependency set) is
+    allocated at or after the requested epoch (Barrier.java withEpoch)."""
     result = au.settable()
+
     if barrier_type.is_global:
-        inner = sp.coordinate_inclusive(
-            node, seekables, blocking=barrier_type.wait_on_global_application)
-        inner.add_listener(lambda v, f: result.set_failure(f) if f is not None
-                           else result.set_success(v))
+        def start_global(_v, f):
+            if f is not None:
+                result.set_failure(f)
+                return
+            inner = sp.coordinate_inclusive(
+                node, seekables, blocking=barrier_type.wait_on_global_application)
+            inner.add_listener(lambda v, f2: result.set_failure(f2) if f2 is not None
+                               else result.set_success(v))
+
+        node.with_epoch(min_epoch).begin(start_global)
         return result
 
     # LOCAL: fast path — some covering txn already applied locally at >= epoch
@@ -49,15 +57,21 @@ def barrier(node: "Node", seekables: Seekables, min_epoch: int,
         return result
 
     # slow path: coordinate an inclusive sync point, then await ITS local apply
-    inner = sp.coordinate_inclusive(node, seekables, blocking=False)
-
-    def on_sync_point(sync_point, failure):
-        if failure is not None:
-            result.set_failure(failure)
+    def start_local(_v, f):
+        if f is not None:
+            result.set_failure(f)
             return
-        _await_local_apply(node, sync_point, result)
+        inner = sp.coordinate_inclusive(node, seekables, blocking=False)
 
-    inner.add_listener(on_sync_point)
+        def on_sync_point(sync_point, failure):
+            if failure is not None:
+                result.set_failure(failure)
+                return
+            _await_local_apply(node, sync_point, result)
+
+        inner.add_listener(on_sync_point)
+
+    node.with_epoch(min_epoch).begin(start_local)
     return result
 
 

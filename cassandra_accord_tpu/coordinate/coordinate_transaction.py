@@ -145,8 +145,7 @@ class _CoordinateTransaction:
                 accept_oks.append(reply)
                 if tracker.record_success(from_node) is RequestStatus.SUCCESS:
                     self.done = True
-                    # deps at executeAt = merge of accept-ok deps (Propose.java)
-                    stable_deps = Deps.merge([deps] + [ok.deps for ok in accept_oks])
+                    stable_deps = this.merge_accept_deps(deps, accept_oks)
                     this.stabilise_and_execute(execute_at, stable_deps, ballot)
 
             def on_failure(self, from_node: int, failure: BaseException) -> None:
@@ -174,6 +173,13 @@ class _CoordinateTransaction:
             else self.txn.keys.slice(ranges)
         return Accept(self.txn_id, scope, wait_for, ballot, execute_at,
                       keys, deps.slice(ranges), route=self.route)
+
+    def merge_accept_deps(self, deps: Deps, accept_oks: List[AcceptOk]) -> Deps:
+        """Deps at executeAt = merge of accept-ok deps (Propose.java).  Sync
+        points override: their deps are fixed by PreAccept (all < txnId), so
+        waiting never forms cycles between concurrent sync points
+        (CoordinateSyncPoint.java:129 'we don't need to fetch deps from Accept')."""
+        return Deps.merge([deps] + [ok.deps for ok in accept_oks])
 
     # -- Stabilise + Execute -------------------------------------------------
     def execute(self, path: str, execute_at: Timestamp, deps: Deps) -> None:

@@ -1,0 +1,96 @@
+"""Durability coordination rounds.
+
+Capability parity with ``accord.coordinate`` CoordinateShardDurable /
+CoordinateGloballyDurable (both files; SURVEY §2.5):
+
+- shard round: coordinate an exclusive sync point over (a sub-range of) one shard;
+  once it has applied at a quorum, everything in its dependency past is
+  majority-durable — broadcast ``SetShardDurable`` so every replica advances its
+  DurableBefore/RedundantBefore and can truncate.
+- global round: ``QueryDurableBefore`` from a quorum of all nodes, min-merge the
+  replies (what EVERYONE agrees is majority-durable is universally durable),
+  broadcast ``SetGloballyDurable``.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, List
+
+from ..local.durability import DurableBefore, DurableEntry
+from ..messages.base import Callback
+from ..messages.durability_messages import (DurableBeforeReply, QueryDurableBefore,
+                                            SetGloballyDurable, SetShardDurable)
+from ..primitives.keys import Ranges
+from ..utils import async_ as au
+from .errors import Exhausted
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+
+def coordinate_shard_durable(node: "Node", ranges: Ranges) -> au.AsyncResult:
+    """Exclusive sync point over ``ranges``; on quorum-applied, SetShardDurable
+    to every replica of those ranges.  Resolves with the SyncPoint."""
+    result = au.settable()
+    inner = node.sync_point(ranges, exclusive=True, blocking=True)
+
+    def on_sync_point(sync_point, failure):
+        if failure is not None:
+            result.set_failure(failure)
+            return
+        participants = sync_point.route.participants()
+        scope = participants if isinstance(participants, Ranges) else ranges
+        topology = node.topology.current()
+        for to in topology.nodes_for(scope):
+            node.send(to, SetShardDurable(sync_point.txn_id, scope))
+        result.set_success(sync_point)
+
+    inner.add_listener(on_sync_point)
+    return result
+
+
+def coordinate_globally_durable(node: "Node") -> au.AsyncResult:
+    """Query DurableBefore from every node; at a quorum, min-merge and
+    broadcast SetGloballyDurable (upgrading majority -> universal)."""
+    result = au.settable()
+    topology = node.topology.current()
+    all_nodes = sorted(topology.nodes())
+    replies: List[DurableBefore] = []
+    state = {"done": False, "acks": 0, "fails": 0}
+    quorum = len(all_nodes) // 2 + 1
+
+    class QueryCallback(Callback):
+        def on_success(self, from_node: int, reply) -> None:
+            if state["done"] or not isinstance(reply, DurableBeforeReply):
+                return
+            replies.append(reply.durable_before)
+            state["acks"] += 1
+            if state["acks"] >= quorum:
+                state["done"] = True
+                _finish()
+
+        def on_failure(self, from_node: int, failure: BaseException) -> None:
+            if state["done"]:
+                return
+            state["fails"] += 1
+            if len(all_nodes) - state["fails"] < quorum:
+                state["done"] = True
+                result.set_failure(Exhausted(None, "query durable before"))
+
+    def _finish():
+        # min-merge: only what EVERY reporting node holds majority-durable can
+        # be called universal; a quorum suffices because majority durability is
+        # itself a quorum property (DurableBefore min/max semantics)
+        merged = replies[0]
+        for db in replies[1:]:
+            merged = merged.merge_min(db)
+        # lift the agreed majority watermark to universal
+        lifted = DurableBefore(merged.map.map_values(
+            lambda e: DurableEntry(e.majority_before, e.majority_before)))
+        for to in all_nodes:
+            node.send(to, SetGloballyDurable(lifted))
+        result.set_success(lifted)
+
+    callback = QueryCallback()
+    for to in all_nodes:
+        node.send(to, QueryDurableBefore(), callback)
+    return result

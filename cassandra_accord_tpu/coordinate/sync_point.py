@@ -17,21 +17,19 @@ dependency-wait — once applied, every transaction in its dependency set is dec
 """
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Union
+from typing import TYPE_CHECKING
 
 from ..local.status import SaveStatus
-from ..primitives.keys import Keys, Ranges
+from ..primitives.keys import Ranges
 from ..primitives.sync_point import SyncPoint
 from ..primitives.timestamp import Ballot, TxnId, TxnKind
-from ..primitives.txn import Txn
+from ..primitives.txn import Seekables, Txn
 from ..utils import async_ as au
 from .coordinate_transaction import _CoordinateTransaction, _ExecuteTxn
 from ..messages.txn_messages import Apply
 
 if TYPE_CHECKING:
     from ..local.node import Node
-
-Seekables = Union[Keys, Ranges]
 
 
 def coordinate_inclusive(node: "Node", seekables: Seekables,
@@ -72,6 +70,31 @@ class _CoordinateSyncPoint(_CoordinateTransaction):
                  blocking: bool):
         super().__init__(node, txn_id, txn, route, result)
         self.blocking = blocking
+
+    def on_preaccepted(self, tracker, oks) -> None:
+        """CoordinateSyncPoint.onPreAccepted: deps merge from ALL replies; only
+        a plain SyncPoint may take the fast path (exclusive always proposes);
+        Accept-round deps are ignored (deps stay < txnId, so concurrent sync
+        points' waits are acyclic)."""
+        from ..primitives.deps import Deps
+        from ..primitives.timestamp import Ballot as _Ballot
+        execute_at = None
+        for ok in oks.values():
+            execute_at = ok.witnessed_at if execute_at is None \
+                else execute_at.merge_max(ok.witnessed_at)
+        if execute_at is not None and execute_at.is_rejected:
+            from .errors import Invalidated
+            self.result.set_failure(Invalidated(self.txn_id, "preaccept rejected"))
+            return
+        deps = Deps.merge([ok.deps for ok in oks.values()])
+        from .coordinate_transaction import ExecutePath
+        if tracker.has_fast_path_accepted() and self.txn_id.kind is TxnKind.SYNC_POINT:
+            self.execute(ExecutePath.FAST, self.txn_id.as_timestamp(), deps)
+        else:
+            self.propose(_Ballot.ZERO, execute_at, deps)
+
+    def merge_accept_deps(self, deps, accept_oks):
+        return deps
 
     def execute(self, path: str, execute_at, deps) -> None:
         _ExecuteSyncPoint(self.node, self.txn_id, self.txn, self.route,

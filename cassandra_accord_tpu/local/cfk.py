@@ -239,6 +239,25 @@ class CommandsForKey:
             self.prune_before = highest_pruned
         return pruned
 
+    def prune_applied_before(self, bound: TxnId) -> int:
+        """Bound-driven prune (GC by RedundantBefore): drop APPLIED/INVALIDATED
+        entries with txn_id < bound; they are implied-applied for late arrivals."""
+        keep: List[TxnInfo] = []
+        pruned = 0
+        highest: Optional[TxnId] = self.prune_before
+        for info in self.by_id:
+            if info.txn_id < bound and info.status in (InternalStatus.APPLIED,
+                                                       InternalStatus.INVALIDATED):
+                pruned += 1
+                if highest is None or info.txn_id > highest:
+                    highest = info.txn_id
+            else:
+                keep.append(info)
+        if pruned:
+            self.by_id = keep
+            self.prune_before = highest
+        return pruned
+
     def is_pruned(self, txn_id: TxnId) -> bool:
         # prune_before is the highest pruned id, inclusive
         return self.prune_before is not None and txn_id <= self.prune_before \

@@ -68,6 +68,10 @@ class CommandStore:
         # store-wide plus per-key via cfk.max_timestamp
         self.max_conflict_ts: Optional[Timestamp] = None
         self.progress_log: ProgressLog = ProgressLog.NOOP
+        # GC bounds + durability watermarks (RedundantBefore/DurableBefore)
+        from .durability import DurableBefore, RedundantBefore
+        self.redundant_before: RedundantBefore = RedundantBefore.EMPTY
+        self.durable_before: DurableBefore = DurableBefore.EMPTY
 
     # -- ranges -------------------------------------------------------------
     def update_ranges(self, epoch: int, ranges: Ranges) -> None:
@@ -257,6 +261,74 @@ class SafeCommandStore:
             lst.remove(callback)
             if not lst:
                 del self.store.transient_listeners[txn_id]
+
+    # -- durability / GC (RedundantBefore, DurableBefore, Cleanup) ------------
+    def redundant_before(self):
+        return self.store.redundant_before
+
+    def durable_before(self):
+        return self.store.durable_before
+
+    def mark_locally_applied_before(self, txn_id: TxnId, ranges: Ranges) -> None:
+        """Everything on ``ranges`` before ``txn_id`` has locally applied (fired
+        when an exclusive sync point applies here: it waited on all of it)."""
+        from .durability import RedundantBefore
+        local = ranges.intersection(self.store.all_ranges())
+        if local:
+            self.store.redundant_before = self.store.redundant_before.merge(
+                RedundantBefore.of(local, locally_applied_before=txn_id))
+
+    def mark_shard_durable(self, txn_id: TxnId, ranges: Ranges) -> None:
+        """SetShardDurable: everything on ``ranges`` before ``txn_id`` is durable
+        at a quorum (majority watermark) and shard-applied."""
+        from .durability import DurableBefore, RedundantBefore
+        local = ranges.intersection(self.store.all_ranges())
+        if local:
+            self.store.durable_before = self.store.durable_before.merge(
+                DurableBefore.of(local, majority_before=txn_id))
+            self.store.redundant_before = self.store.redundant_before.merge(
+                RedundantBefore.of(local, shard_applied_before=txn_id))
+        self.run_gc()
+
+    def merge_durable_before(self, durable_before) -> None:
+        """SetGloballyDurable: adopt a cluster-wide durability watermark map."""
+        self.store.durable_before = self.store.durable_before.merge(durable_before)
+        self.run_gc()
+
+    def run_gc(self) -> None:
+        """Truncate/erase commands per the Cleanup lattice; prune per-key and
+        range indexes below the shard-redundant bound (Cleanup.java, cfk pruning)."""
+        from .durability import Cleanup, should_cleanup
+        from . import commands as C
+        store = self.store
+        for txn_id, cmd in list(store.commands.items()):
+            cleanup = should_cleanup(cmd, store.redundant_before, store.durable_before)
+            if cleanup is Cleanup.NO:
+                continue
+            if cleanup is Cleanup.ERASE:
+                from .status import SaveStatus
+                parts = cmd.route.participants() if cmd.route is not None else None
+                if cmd.save_status is SaveStatus.INVALIDATED or (
+                        parts is not None
+                        and store.redundant_before.is_shard_redundant(txn_id, parts)):
+                    # physically drop: late messages are fended off by the
+                    # shard-redundant guard in commands (_is_shard_redundant);
+                    # invalidated txns can only ever be re-invalidated
+                    del store.commands[txn_id]
+                    store.transient_listeners.pop(txn_id, None)
+                    continue
+            C.truncate(self, cmd, cleanup)
+        # prune conflict indexes below the shard-applied bound per key
+        for rk, cfk in store.cfks.items():
+            bound = store.redundant_before.shard_redundant_before(rk)
+            if bound is not None:
+                cfk.prune_applied_before(bound)
+        for txn_id in list(store.range_txns):
+            rngs, _status = store.range_txns[txn_id]
+            if store.redundant_before.is_locally_redundant(txn_id, rngs) \
+                    and store.redundant_before.min_shard_redundant_before(rngs) is not None \
+                    and txn_id < store.redundant_before.min_shard_redundant_before(rngs):
+                del store.range_txns[txn_id]
 
     # -- context ------------------------------------------------------------
     def data_store(self) -> DataStore:

@@ -15,7 +15,7 @@ from typing import TYPE_CHECKING, Optional
 from ..primitives.deps import Deps
 from ..primitives.keys import Ranges
 from ..primitives.route import Route
-from ..primitives.timestamp import Ballot, Timestamp, TxnId
+from ..primitives.timestamp import Ballot, Timestamp, TxnId, TxnKind
 from ..primitives.txn import PartialTxn, Writes
 from ..utils.invariants import Invariants, check_state
 from .cfk import InternalStatus, manages_execution
@@ -39,10 +39,24 @@ class AcceptOutcome(enum.Enum):
 # PreAccept (Commands.java:113)
 # ---------------------------------------------------------------------------
 
+def _is_shard_redundant(safe_store: SafeCommandStore, txn_id: TxnId,
+                        route: Optional[Route]) -> bool:
+    """Erased-tombstone guard: a txn below the shard-applied watermark on its
+    whole footprint was applied (with everything before it) at a quorum; late
+    messages about it must not resurrect state (Commands' redundantBefore
+    checks / ErasedSafeCommand semantics)."""
+    if route is None:
+        return False
+    return safe_store.redundant_before().is_shard_redundant(
+        txn_id, route.participants())
+
+
 def preaccept(safe_store: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn,
               route: Route, ballot: Ballot = Ballot.ZERO) -> AcceptOutcome:
     """Witness the txn; propose witnessedAt = txnId if no conflict is later, else a
     fresh unique timestamp greater than every conflict (PreAccept.java:245-267)."""
+    if _is_shard_redundant(safe_store, txn_id, route):
+        return AcceptOutcome.TRUNCATED
     command = safe_store.get_or_create(txn_id)
     if command.save_status.is_truncated:
         return AcceptOutcome.TRUNCATED
@@ -170,6 +184,8 @@ def commit(safe_store: SafeCommandStore, txn_id: TxnId, save_status: SaveStatus,
     maybe_execute) — Commands.java:289."""
     check_state(save_status in (SaveStatus.COMMITTED, SaveStatus.STABLE),
                 "commit called with %s", save_status)
+    if _is_shard_redundant(safe_store, txn_id, route):
+        return CommitOutcome.REDUNDANT
     command = safe_store.get_or_create(txn_id)
     if command.save_status.is_truncated or command.save_status is SaveStatus.INVALIDATED:
         return CommitOutcome.REDUNDANT
@@ -225,6 +241,8 @@ def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId) -> None:
 def apply_(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
            execute_at: Timestamp, partial_deps: Optional[Deps],
            partial_txn: Optional[PartialTxn], writes: Optional[Writes], result) -> CommitOutcome:
+    if _is_shard_redundant(safe_store, txn_id, route):
+        return CommitOutcome.REDUNDANT
     command = safe_store.get_or_create(txn_id)
     if command.save_status.is_truncated or command.save_status is SaveStatus.INVALIDATED:
         return CommitOutcome.REDUNDANT
@@ -264,8 +282,14 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
     waiting = set()
     local_ranges = safe_store.store.all_ranges()
     deps = command.partial_deps.slice(local_ranges) if command.partial_deps is not None else Deps.NONE
+    redundant = safe_store.redundant_before()
     for dep_id in deps.txn_ids():
         if dep_id == command.txn_id:
+            continue
+        # removeRedundantDependencies (Commands.java:704-705): deps below the
+        # locally-redundant bound have applied (or are subsumed by bootstrap)
+        dep_parts = deps.participants(dep_id)
+        if dep_parts is not None and redundant.is_locally_redundant(dep_id, dep_parts):
             continue
         if _still_blocks(safe_store, command, dep_id, execute_at):
             waiting.add(dep_id)
@@ -347,6 +371,14 @@ def _apply_writes(safe_store: SafeCommandStore, command: Command) -> None:
             return
         command.set_save_status(SaveStatus.APPLIED)
         safe_store.register_witness(command, InternalStatus.APPLIED)
+        # an applied exclusive sync point waited on everything before it on its
+        # ranges: all of that has now locally applied (RedundantBefore advance)
+        if command.txn_id.kind is TxnKind.EXCLUSIVE_SYNC_POINT \
+                and command.route is not None:
+            participants = command.route.participants()
+            from ..primitives.keys import Ranges as _Ranges
+            if isinstance(participants, _Ranges):
+                safe_store.mark_locally_applied_before(command.txn_id, participants)
         safe_store.progress_log().executed(command, _is_progress_shard(safe_store, command))
         agent = safe_store.agent()
         agent.metrics_events_listener().on_applied(command, t0)
@@ -356,6 +388,40 @@ def _apply_writes(safe_store: SafeCommandStore, command: Command) -> None:
         post_apply()
     else:
         command.writes.apply_to(safe_store, ranges).begin(post_apply)
+
+
+# ---------------------------------------------------------------------------
+# Truncation / erasure (Commands.java:824-930, Cleanup.java)
+# ---------------------------------------------------------------------------
+
+def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
+    """Apply a Cleanup decision: strip payloads, downgrade to a truncated
+    SaveStatus.  TRUNCATE_WITH_OUTCOME keeps writes/result for peers that may
+    still need the outcome; ERASE drops everything but the tombstone."""
+    from .durability import Cleanup
+    if command.save_status is SaveStatus.INVALIDATED:
+        # invalidation is terminal: strip any payloads left from earlier phases
+        command.partial_txn = None
+        command.partial_deps = None
+        command.waiting_on = None
+        command.listeners.clear()
+        return
+    command.partial_deps = None
+    command.waiting_on = None
+    command.listeners.clear()
+    if cleanup is Cleanup.TRUNCATE_WITH_OUTCOME:
+        command.partial_txn = None
+        command.set_save_status(SaveStatus.TRUNCATED_APPLY)
+    elif cleanup is Cleanup.TRUNCATE:
+        command.partial_txn = None
+        command.writes = None
+        command.result = None
+        command.set_save_status(SaveStatus.TRUNCATED_APPLY)
+    elif cleanup is Cleanup.ERASE:
+        command.partial_txn = None
+        command.writes = None
+        command.result = None
+        command.set_save_status(SaveStatus.ERASED)
 
 
 # ---------------------------------------------------------------------------

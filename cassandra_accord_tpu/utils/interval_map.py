@@ -62,6 +62,20 @@ class ReducingIntervalMap(Generic[V]):
         i = bisect_right(self.bounds, key)
         return self.values[i]
 
+    def map_values(self, fn: Callable[[V], Any]) -> "ReducingIntervalMap":
+        """New map with ``fn`` applied to every non-None value."""
+        return ReducingIntervalMap(
+            self.bounds, tuple(None if v is None else fn(v) for v in self.values))
+
+    def values_over(self, start, end) -> List[Optional[V]]:
+        """Every distinct value the map takes over [start, end)."""
+        i = bisect_right(self.bounds, start)
+        out = [self.values[i]]
+        while i < len(self.bounds) and self.bounds[i] < end:
+            out.append(self.values[i + 1])
+            i += 1
+        return out
+
     def is_empty(self) -> bool:
         return all(v is None for v in self.values)
 
