@@ -176,3 +176,11 @@ def range_read_txn(ranges: Ranges):
     """Build a range-domain read Txn over ``ranges`` (reference range queries)."""
     from ..primitives.txn import Txn
     return Txn.of(ranges, ListRangeRead(ranges), None, ListQuery())
+
+
+def ephemeral_read_txn(keys_read: List[Key]):
+    """Build an ephemeral (1-round, non-durable) read Txn (Txn.Kind.EphemeralRead)."""
+    from ..primitives.timestamp import TxnKind
+    from ..primitives.txn import Txn
+    keys = Keys.of(keys_read)
+    return Txn(TxnKind.EPHEMERAL_READ, keys, ListRead(keys), None, ListQuery())

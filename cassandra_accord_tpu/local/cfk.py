@@ -214,40 +214,16 @@ class CommandsForKey:
         return True
 
     # -- pruning (doc CommandsForKey.java:115-143) ---------------------------
-    def maybe_prune(self, prune_before_hlc_delta: int) -> int:
-        """Drop APPLIED/INVALIDATED entries well behind the max HLC; returns count
-        pruned.  prune_before is retained so late-arriving deps below it are treated
-        as already-applied rather than unknown."""
-        if not self.by_id:
-            return 0
-        max_hlc = self.max_hlc()
-        cutoff_hlc = max_hlc - prune_before_hlc_delta
-        keep: List[TxnInfo] = []
-        pruned = 0
-        highest_pruned: Optional[TxnId] = self.prune_before
-        for info in self.by_id:
-            prunable = (info.status in (InternalStatus.APPLIED, InternalStatus.INVALIDATED)
-                        and info.txn_id.hlc < cutoff_hlc)
-            if prunable:
-                pruned += 1
-                if highest_pruned is None or info.txn_id > highest_pruned:
-                    highest_pruned = info.txn_id
-            else:
-                keep.append(info)
-        if pruned:
-            self.by_id = keep
-            self.prune_before = highest_pruned
-        return pruned
-
-    def prune_applied_before(self, bound: TxnId) -> int:
-        """Bound-driven prune (GC by RedundantBefore): drop APPLIED/INVALIDATED
-        entries with txn_id < bound; they are implied-applied for late arrivals."""
+    def _prune(self, prunable: Callable[["TxnInfo"], bool]) -> int:
+        """Drop APPLIED/INVALIDATED entries matching ``prunable``; prune_before
+        is retained so late-arriving deps below it are treated as
+        already-applied rather than unknown."""
         keep: List[TxnInfo] = []
         pruned = 0
         highest: Optional[TxnId] = self.prune_before
         for info in self.by_id:
-            if info.txn_id < bound and info.status in (InternalStatus.APPLIED,
-                                                       InternalStatus.INVALIDATED):
+            if info.status in (InternalStatus.APPLIED, InternalStatus.INVALIDATED) \
+                    and prunable(info):
                 pruned += 1
                 if highest is None or info.txn_id > highest:
                     highest = info.txn_id
@@ -257,6 +233,18 @@ class CommandsForKey:
             self.by_id = keep
             self.prune_before = highest
         return pruned
+
+    def maybe_prune(self, prune_before_hlc_delta: int) -> int:
+        """HLC-delta policy prune: drop applied entries well behind the max HLC."""
+        if not self.by_id:
+            return 0
+        cutoff_hlc = self.max_hlc() - prune_before_hlc_delta
+        return self._prune(lambda info: info.txn_id.hlc < cutoff_hlc)
+
+    def prune_applied_before(self, bound: TxnId) -> int:
+        """Bound-driven prune (GC by RedundantBefore): drop applied entries with
+        txn_id < bound; they are implied-applied for late arrivals."""
+        return self._prune(lambda info: info.txn_id < bound)
 
     def is_pruned(self, txn_id: TxnId) -> bool:
         # prune_before is the highest pruned id, inclusive

@@ -64,14 +64,14 @@ class CommandStore:
         self.range_txns: Dict[TxnId, Tuple[Ranges, InternalStatus]] = {}
         # transient listeners: txn_id -> callbacks fired on every status change
         self.transient_listeners: Dict[TxnId, List[Callable]] = {}
-        # max executeAt witnessed per key-space (MaxConflicts): tracked coarsely
-        # store-wide plus per-key via cfk.max_timestamp
-        self.max_conflict_ts: Optional[Timestamp] = None
         self.progress_log: ProgressLog = ProgressLog.NOOP
-        # GC bounds + durability watermarks (RedundantBefore/DurableBefore)
-        from .durability import DurableBefore, RedundantBefore
+        # GC bounds + durability watermarks + per-range max executeAt
+        from .durability import DurableBefore, MaxConflicts, RedundantBefore
         self.redundant_before: RedundantBefore = RedundantBefore.EMPTY
         self.durable_before: DurableBefore = DurableBefore.EMPTY
+        # MaxConflicts (MaxConflicts.java:32): per-range max executeAt of
+        # RANGE-domain txns; key-domain maxima come precisely from each cfk
+        self.max_conflicts: MaxConflicts = MaxConflicts()
 
     # -- ranges -------------------------------------------------------------
     def update_ranges(self, epoch: int, ranges: Ranges) -> None:
@@ -206,17 +206,14 @@ class SafeCommandStore:
                 cfk = self.cfk_if_exists(rk)
                 if cfk is not None:
                     bump(cfk.max_timestamp())
-        if ranges is not None and self.store.cfks:
+            # range txns covering these keys (per-range MaxConflicts map)
+            bump(self.store.max_conflicts.get(keys))
+        if ranges is not None:
             for rng in ranges:
                 for rk, cfk in self.store.cfks.items():
                     if rng.contains(rk):
                         bump(cfk.max_timestamp())
-        # range txns conflict with everything they cover
-        for tid, (rngs, _status) in self.store.range_txns.items():
-            if keys is not None and any(rngs.contains(k.to_routing() if hasattr(k, "to_routing") else k) for k in keys):
-                bump(tid)
-            if ranges is not None and any(rngs.intersects(r) for r in ranges):
-                bump(tid)
+            bump(self.store.max_conflicts.get(ranges))
         return out
 
     # -- registration -------------------------------------------------------
@@ -233,8 +230,7 @@ class SafeCommandStore:
             if prev is None or status > prev[1]:
                 self.store.range_txns[command.txn_id] = (rngs, status)
             ts = command.execute_at if command.execute_at is not None else command.txn_id
-            if self.store.max_conflict_ts is None or ts > self.store.max_conflict_ts:
-                self.store.max_conflict_ts = ts
+            self.store.max_conflicts = self.store.max_conflicts.update(rngs, ts)
         else:
             ea = command.execute_at
             for rk in scope:

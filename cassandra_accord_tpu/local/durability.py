@@ -195,9 +195,12 @@ class DurableBefore:
         return DurableBefore(self.map.merge(other.map, lambda a, b: a.merge_max(b)))
 
     def merge_min(self, other: "DurableBefore") -> "DurableBefore":
-        """Min-merge: the watermark EVERY contributor agrees on
-        (QueryDurableBefore reduction for the global round)."""
-        return DurableBefore(self.map.merge(other.map, lambda a, b: a.merge_min(b)))
+        """Min-merge: the watermark EVERY contributor agrees on — a range absent
+        from either side is absent from the result (strict merge; an empty reply
+        must NOT count as agreement, or watermarks would be falsely lifted to
+        universal and enable premature erasure)."""
+        return DurableBefore(self.map.merge(other.map, lambda a, b: a.merge_min(b),
+                                            strict=True))
 
     def entry(self, key: RoutingKey) -> Optional[DurableEntry]:
         return self.map.get(key)

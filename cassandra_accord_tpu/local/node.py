@@ -114,12 +114,15 @@ class Node(ConfigurationService.Listener):
     # -- coordination entry points (Node.java:573+) ---------------------------
     def coordinate(self, txn: Txn, txn_id: Optional[TxnId] = None) -> au.AsyncResult:
         from ..coordinate.coordinate_transaction import coordinate_transaction
+        from ..coordinate.ephemeral_read import coordinate_ephemeral_read
         if txn_id is None:
             txn_id = self.next_txn_id(txn.kind, txn.domain)
+        start = coordinate_ephemeral_read if txn.kind is TxnKind.EPHEMERAL_READ \
+            else coordinate_transaction
         result = au.settable()
         self.with_epoch(txn_id.epoch).begin(
             lambda _v, f: result.set_failure(f) if f is not None
-            else coordinate_transaction(self, txn_id, txn, result))
+            else start(self, txn_id, txn, result))
         return result
 
     def recover(self, txn_id: TxnId, txn: Txn, route: Route) -> au.AsyncResult:

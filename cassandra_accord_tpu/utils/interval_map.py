@@ -81,14 +81,24 @@ class ReducingIntervalMap(Generic[V]):
 
     # -- merge --------------------------------------------------------------
     def merge(self, other: "ReducingIntervalMap[V]",
-              reduce: Callable[[V, V], V]) -> "ReducingIntervalMap[V]":
-        """Pointwise merge; where both maps have a value, combine with ``reduce``."""
-        def combine(a, b):
-            if a is None:
-                return b
-            if b is None:
-                return a
-            return reduce(a, b)
+              reduce: Callable[[V, V], V],
+              strict: bool = False) -> "ReducingIntervalMap[V]":
+        """Pointwise merge; where both maps have a value, combine with ``reduce``.
+        Default: None (absent) merges as the identity — the other side wins.
+        ``strict``: None annihilates — an interval absent from EITHER map is
+        absent from the result (for min-style agreement merges)."""
+        if strict:
+            def combine(a, b):
+                if a is None or b is None:
+                    return None
+                return reduce(a, b)
+        else:
+            def combine(a, b):
+                if a is None:
+                    return b
+                if b is None:
+                    return a
+                return reduce(a, b)
 
         bounds: List = sorted(set(self.bounds) | set(other.bounds))
         values: List = []

@@ -1,0 +1,85 @@
+"""Ephemeral reads: 1-round, non-durable, ordered after witnessed writes.
+
+Parity targets: CoordinateEphemeralRead.java:57-150, GetEphemeralReadDeps.java,
+ReadEphemeralTxnData.java; witness matrix — EphemeralRead is witnessed by Nothing.
+"""
+from cassandra_accord_tpu.harness.cluster import Cluster
+from cassandra_accord_tpu.impl.list_store import (ListResult, ephemeral_read_txn,
+                                                  list_txn)
+from cassandra_accord_tpu.primitives.keys import IntKey, Range
+from cassandra_accord_tpu.primitives.timestamp import TxnKind
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+
+
+def k(v):
+    return IntKey(v)
+
+
+def make_cluster(seed=1, nodes=(1, 2, 3), shards=None, **kw):
+    if shards is None:
+        shards = [Shard(Range(k(0), k(1000)), list(nodes))]
+    return Cluster(Topology(1, shards), seed=seed, **kw)
+
+
+def submit_write(cluster, node_id, appends):
+    return cluster.nodes[node_id].coordinate(
+        list_txn([], {k(key): v for key, v in appends.items()}))
+
+
+def test_ephemeral_read_sees_prior_writes():
+    cluster = make_cluster()
+    w = submit_write(cluster, 1, {5: "a"})
+    assert cluster.run_until(w.is_done)
+    cluster.run_until_idle()
+    r = cluster.nodes[2].coordinate(ephemeral_read_txn([k(5)]))
+    assert cluster.run_until(r.is_done)
+    assert isinstance(r.value, ListResult)
+    assert r.value.reads[k(5)] == ("a",)
+
+
+def test_ephemeral_read_leaves_no_durable_state():
+    cluster = make_cluster(seed=3)
+    w = submit_write(cluster, 1, {9: "x"})
+    assert cluster.run_until(w.is_done)
+    r = cluster.nodes[3].coordinate(ephemeral_read_txn([k(9)]))
+    assert cluster.run_until(r.is_done)
+    cluster.run_until_idle()
+    for n in cluster.nodes:
+        for store in cluster.nodes[n].command_stores.all_stores():
+            for txn_id in store.commands:
+                assert txn_id.kind is not TxnKind.EPHEMERAL_READ, \
+                    f"ephemeral read left command state on node {n}"
+            for cfk in store.cfks.values():
+                for info in cfk.by_id:
+                    assert info.txn_id.kind is not TxnKind.EPHEMERAL_READ
+
+
+def test_ephemeral_read_waits_for_concurrent_write():
+    """An ephemeral read that witnesses an in-flight write's deps must observe
+    it once the write resolves (ordered-after semantics)."""
+    cluster = make_cluster(seed=7)
+    # seed some history so deps exist
+    w0 = submit_write(cluster, 1, {21: "base"})
+    assert cluster.run_until(w0.is_done)
+    w1 = submit_write(cluster, 2, {21: "mid"})
+    r = cluster.nodes[3].coordinate(ephemeral_read_txn([k(21)]))
+    assert cluster.run_until(lambda: w1.is_done() and r.is_done())
+    got = r.value.reads[k(21)]
+    assert got[0] == "base", got
+    # must be a prefix of the final list
+    cluster.run_until_idle()
+    final = cluster.stores[1].get(k(21))
+    assert got == final[: len(got)], (got, final)
+
+
+def test_ephemeral_read_multiple_keys_across_shards():
+    shards = [Shard(Range(k(0), k(100)), [1, 2, 3]),
+              Shard(Range(k(100), k(200)), [1, 2, 3])]
+    cluster = make_cluster(shards=shards, seed=11)
+    w = submit_write(cluster, 1, {50: "l", 150: "r"})
+    assert cluster.run_until(w.is_done)
+    cluster.run_until_idle()
+    r = cluster.nodes[2].coordinate(ephemeral_read_txn([k(50), k(150)]))
+    assert cluster.run_until(r.is_done)
+    assert r.value.reads[k(50)] == ("l",)
+    assert r.value.reads[k(150)] == ("r",)
