@@ -123,7 +123,28 @@ class _CoordinateTransaction:
             self.result.set_failure(Invalidated(self.txn_id, "preaccept rejected"))
         else:
             deps = Deps.merge([ok.deps for ok in oks.values()])
-            self.propose(Ballot.ZERO, execute_at, deps)
+            self.extend_to_epoch(execute_at,
+                                 lambda: self.propose(Ballot.ZERO, execute_at, deps))
+
+    def extend_to_epoch(self, execute_at: Timestamp, cont) -> None:
+        """Epoch-spanning coordination (CoordinationAdapter.Invoke topology
+        recompute; AbstractCoordinatePreAccept epoch-extension): when executeAt
+        lands in a later epoch than txnId, every subsequent round must also
+        contact the execution epoch's replicas — otherwise replicas that joined
+        in the new epoch never receive Stable/Apply and replica sets diverge."""
+        if execute_at is None or execute_at.epoch <= self.topologies.current_epoch:
+            cont()
+            return
+
+        def go(_v, f):
+            if f is not None:
+                self.result.set_failure(f)
+                return
+            self.topologies = self.node.topology.with_unsynced_epochs(
+                self.route, self.txn_id.epoch, execute_at.epoch)
+            cont()
+
+        self.node.with_epoch(execute_at.epoch).begin(go)
 
     # -- Propose (Accept round, Propose.java) --------------------------------
     def propose(self, ballot: Ballot, execute_at: Timestamp, deps: Deps) -> None:
@@ -218,7 +239,10 @@ class _ExecuteTxn:
         self.result = result
         self.require_stable_quorum = require_stable_quorum
         self.ballot = ballot
-        self.read_tracker = ReadTracker(topologies)
+        # reads execute against the EXECUTION epoch's replicas only (a replica
+        # that lost a range by then cannot serve its data) — ExecuteTxn.java
+        from ..topology.topology import Topologies
+        self.read_tracker = ReadTracker(Topologies([topologies.current()]))
         self.stable_tracker = QuorumTracker(topologies)
         self.data = None
         self.done = False
@@ -239,6 +263,17 @@ class _ExecuteTxn:
                             is RequestStatus.SUCCESS:
                         this.maybe_finish()
                 elif isinstance(reply, ReadNack):
+                    if reply.reason == "unavailable":
+                        # replica is bootstrapping these ranges: read elsewhere
+                        # (the Stable part already acked separately)
+                        status, retries = this.read_tracker.record_read_failure(from_node)
+                        if status is RequestStatus.FAILED:
+                            this.done = True
+                            this.result.set_failure(Exhausted(this.txn_id, "read"))
+                            return
+                        for to in retries:
+                            this.send_read_retry(to)
+                        return
                     this.done = True
                     this.result.set_failure(Insufficient(this.txn_id, reply.reason))
                 elif isinstance(reply, CommitNack):
@@ -385,15 +420,17 @@ def resume_propose(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
                    deps: Deps) -> None:
     """Re-run the Accept round at ``ballot`` (recovery of an Accepted txn, or
     re-proposal at txnId when the fast path may have succeeded)."""
-    _CoordinateTransaction(node, txn_id, txn, route, result).propose(ballot, execute_at, deps)
+    c = _CoordinateTransaction(node, txn_id, txn, route, result)
+    c.extend_to_epoch(execute_at, lambda: c.propose(ballot, execute_at, deps))
 
 
 def resume_stabilise(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
                      result: au.Settable, ballot: Ballot, execute_at: Timestamp,
                      deps: Deps) -> None:
     """Re-run Stable+Execute (recovery of a Committed/Stable txn)."""
-    _CoordinateTransaction(node, txn_id, txn, route, result) \
-        .stabilise_and_execute(execute_at, deps, ballot)
+    c = _CoordinateTransaction(node, txn_id, txn, route, result)
+    c.extend_to_epoch(execute_at,
+                      lambda: c.stabilise_and_execute(execute_at, deps, ballot))
 
 
 def persist_maximal(node: "Node", txn_id: TxnId, txn: Txn, route: Route,

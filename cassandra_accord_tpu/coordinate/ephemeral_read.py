@@ -126,12 +126,16 @@ class _CoordinateEphemeralRead:
                         data_holder["done"] = True
                         this.finish(data_holder["data"])
                 elif isinstance(reply, ReadNack):
-                    data_holder["done"] = True
-                    this.result.set_failure(Insufficient(this.txn_id, reply.reason))
+                    # transient single-replica conditions (bootstrapping /
+                    # stale topology): retry the shard's other replicas
+                    self._retry(from_node)
 
             def on_failure(self, from_node: int, failure: BaseException) -> None:
                 if data_holder["done"]:
                     return
+                self._retry(from_node)
+
+            def _retry(self, from_node: int) -> None:
                 status, retries = read_tracker.record_read_failure(from_node)
                 if status is RequestStatus.FAILED:
                     data_holder["done"] = True

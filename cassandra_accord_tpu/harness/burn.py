@@ -47,7 +47,9 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              link_config: Optional[LinkConfig] = None,
              nodes: Optional[int] = None, rf: Optional[int] = None,
              key_count: Optional[int] = None, num_shards: int = 1,
-             allow_failures: bool = False) -> BurnResult:
+             allow_failures: bool = False,
+             topology_churn: bool = False,
+             churn_interval_s: float = 1.0) -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation."""
     rng = RandomSource(seed)
     rf = rf if rf is not None else rng.pick([3, 3, 5])
@@ -69,6 +71,14 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     cluster = Cluster(topology, seed=rng.next_long(), num_shards=num_shards,
                       link_config=link_config)
     member_ids = sorted(cluster.nodes)  # nodes actually replicating some shard
+    churn_task = None
+    if topology_churn:
+        # random topology mutations at a fixed sim-time cadence
+        # (Cluster.java:461, TopologyRandomizer.maybeUpdateTopology)
+        from .topology_randomizer import TopologyRandomizer
+        randomizer = TopologyRandomizer(cluster, rng.fork())
+        churn_task = cluster.scheduler.recurring(churn_interval_s,
+                                                 randomizer.maybe_update_topology)
     verifier = StrictSerializabilityVerifier()
     result = BurnResult(seed)
     zipf = rng.next_boolean()
@@ -125,6 +135,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     try:
         cluster.run_until(lambda: result.ops_ok + result.ops_failed >= ops,
                           max_tasks=5_000_000)
+        if churn_task is not None:
+            churn_task.cancel()  # stop mutating so the cluster can quiesce
         cluster.run_until_idle(max_tasks=5_000_000)
         result.ops_submitted = state["submitted"]
         result.sim_micros = cluster.now_micros
@@ -136,8 +148,9 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         if not allow_failures and result.ops_failed:
             raise HistoryViolation(f"{result.ops_failed} ops failed under a benign network")
         # final replica state must agree per key across replicas covering it
+        # (under churn, judge against the FINAL topology's replica sets)
         final: Dict[IntKey, tuple] = {}
-        for shard in topology.shards:
+        for shard in cluster.topologies[-1].shards:
             lists = {}
             for n in shard.nodes:
                 store = cluster.stores[n]

@@ -254,9 +254,22 @@ class SimConfigService(ConfigurationService):
         return None
 
     def fetch_topology_for_epoch(self, epoch: int) -> None:
-        t = self.get_topology_for_epoch(epoch)
-        if t is not None:
-            self.cluster.queue.add_after(0, lambda: self.notify(t))
+        if self.get_topology_for_epoch(epoch) is not None:
+            self.cluster.queue.add_after(0, self.deliver_pending)
+
+    def deliver_pending(self) -> None:
+        """Deliver every not-yet-delivered epoch, in order (TopologyManager
+        requires consecutive epochs)."""
+        node = self.cluster.nodes[self.node_id]
+        while True:
+            current = node.topology.current_epoch
+            nxt = self.get_topology_for_epoch(current + 1) if current > 0 \
+                else self.cluster.topologies[0]
+            if nxt is None or (current > 0 and nxt.epoch <= current):
+                return
+            self.notify(nxt)
+            if node.topology.current_epoch == current:
+                return  # listener refused (shouldn't happen); avoid spinning
 
     def notify(self, topology: Topology) -> None:
         for listener in self.listeners:
@@ -295,7 +308,8 @@ class Cluster:
                  link_config: Optional[LinkConfig] = None,
                  reply_timeout_s: float = 2.0,
                  progress_log: bool = False,
-                 progress_poll_s: float = 0.5):
+                 progress_poll_s: float = 0.5,
+                 extra_nodes: Optional[List[int]] = None):
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
         self.scheduler = SimScheduler(self.queue)
@@ -312,7 +326,7 @@ class Cluster:
             from ..impl.progress_log import progress_log_factory
             plf = progress_log_factory(progress_poll_s)
         agent = SimAgent(self)
-        for node_id in sorted(topology.nodes()):
+        for node_id in sorted(set(topology.nodes()) | set(extra_nodes or ())):
             sink = SimMessageSink(node_id, self)
             store = ListStore(node_id)
             self.sinks[node_id] = sink
@@ -323,6 +337,18 @@ class Cluster:
                 now_micros=lambda: self.queue.now_micros,
                 num_shards=num_shards,
                 progress_log_factory=plf)
+
+    # -- topology change -----------------------------------------------------
+    def update_topology(self, new_topology: Topology) -> None:
+        """Advance the cluster to a new epoch: every node learns it after a
+        random delay (epoch propagation skew), in epoch order."""
+        assert new_topology.epoch == self.topologies[-1].epoch + 1, \
+            f"epoch must advance by 1: {self.topologies[-1].epoch} -> {new_topology.epoch}"
+        self.topologies.append(new_topology)
+        for node_id in sorted(self.nodes):
+            delay = self.rng.next_int(200, 5000)
+            svc = self.nodes[node_id].config_service
+            self.queue.add_after(delay, svc.deliver_pending)
 
     # -- message routing ----------------------------------------------------
     def route(self, from_node: int, to_node: int, request: Request, msg_id: int,

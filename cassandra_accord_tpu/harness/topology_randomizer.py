@@ -1,0 +1,86 @@
+"""Random topology mutations for the burn test.
+
+Capability parity with ``accord.burn.TopologyRandomizer`` (TopologyRandomizer.java:1-524):
+periodically mutate the cluster topology — move a replica between nodes, split a
+shard's range, merge adjacent shards — driving live epoch adoption, bootstrap
+(data fetch + exclusive sync point fencing) and epoch-sync machinery under load.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, List, Optional
+
+from ..primitives.keys import Range
+from ..topology.topology import Shard, Topology
+from ..utils.random import RandomSource
+
+if TYPE_CHECKING:
+    from .cluster import Cluster
+
+
+class TopologyRandomizer:
+    def __init__(self, cluster: "Cluster", rng: RandomSource,
+                 candidate_nodes: Optional[List[int]] = None):
+        self.cluster = cluster
+        self.rng = rng
+        self.candidates = sorted(candidate_nodes or cluster.nodes)
+
+    def maybe_update_topology(self) -> Optional[Topology]:
+        """Apply one random mutation; returns the new topology (or None if the
+        chosen mutation was not applicable)."""
+        current = self.cluster.topologies[-1]
+        mutation = self.rng.pick(["move", "move", "split", "merge"])
+        shards = list(current.shards)
+        if mutation == "move":
+            new_shards = self._move(shards)
+        elif mutation == "split":
+            new_shards = self._split(shards)
+        else:
+            new_shards = self._merge(shards)
+        if new_shards is None:
+            return None
+        topology = Topology(current.epoch + 1, new_shards)
+        self.cluster.update_topology(topology)
+        return topology
+
+    # -- mutations -----------------------------------------------------------
+    def _move(self, shards: List[Shard]) -> Optional[List[Shard]]:
+        """Replace one replica of one shard with a node not currently in it."""
+        idx = self.rng.next_int(len(shards))
+        shard = shards[idx]
+        outside = [n for n in self.candidates if n not in shard.nodes]
+        if not outside:
+            return None
+        newcomer = self.rng.pick(outside)
+        leaver = self.rng.pick(list(shard.nodes))
+        replicas = [newcomer if n == leaver else n for n in shard.nodes]
+        shards[idx] = Shard(shard.range, replicas)
+        return shards
+
+    def _split(self, shards: List[Shard]) -> Optional[List[Shard]]:
+        """Split one shard's range at an interior point."""
+        idx = self.rng.next_int(len(shards))
+        shard = shards[idx]
+        start, end = shard.range.start, shard.range.end
+        sv, ev = getattr(start, "value", None), getattr(end, "value", None)
+        if not isinstance(sv, int) or not isinstance(ev, int) or ev - sv < 2:
+            return None
+        mid = sv + 1 + self.rng.next_int(ev - sv - 1)
+        cls = type(start)
+        prefix = getattr(start, "prefix", 0)
+        mid_key = cls(mid, prefix)
+        shards[idx: idx + 1] = [Shard(Range(start, mid_key), list(shard.nodes)),
+                                Shard(Range(mid_key, end), list(shard.nodes))]
+        return shards
+
+    def _merge(self, shards: List[Shard]) -> Optional[List[Shard]]:
+        """Merge two adjacent shards (the survivors' replicas bootstrap the
+        merged range)."""
+        if len(shards) < 2:
+            return None
+        idx = self.rng.next_int(len(shards) - 1)
+        a, b = shards[idx], shards[idx + 1]
+        if a.range.end != b.range.start or a.rf != b.rf:
+            return None
+        shards[idx: idx + 2] = [Shard(Range(a.range.start, b.range.end),
+                                      list(a.nodes))]
+        return shards

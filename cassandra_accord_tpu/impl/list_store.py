@@ -40,8 +40,63 @@ class ListStore(DataStore):
         return sorted(k for k in self.data if rng.contains(k.to_routing()))
 
     def fetch(self, node, safe_store, ranges, sync_point, fetch_ranges):
-        # in-memory bootstrap: nothing to stream in unit tests; report fetched
-        fetch_ranges.fetched(ranges)
+        """Pull ``ranges``' contents from a prior-epoch replica (bootstrap
+        streaming; impl/AbstractFetchCoordinator.java).  Sources have applied
+        the fencing sync point, so their data is complete up to it; entries are
+        timestamped so concurrent Apply traffic composes idempotently."""
+        from ..messages.base import Callback
+        from ..messages.fetch_messages import FetchStoreData, FetchStoreDataOk
+
+        # fetch plan: per prior-epoch SHARD slice, from that shard's replicas —
+        # a single source need not cover all the ranges (they may span shards
+        # with disjoint replica sets)
+        epoch = sync_point.txn_id.epoch
+        prior = None
+        for e in range(epoch - 1, node.topology.min_epoch - 1, -1):
+            if node.topology.has_epoch(e):
+                prior = node.topology.topology_for_epoch(e)
+                break
+        plan = []   # (sub_ranges, [candidate sources])
+        if prior is not None:
+            for shard in prior.shards:
+                sub = ranges.intersection(Ranges.of(shard.range))
+                if not sub:
+                    continue
+                candidates = [n for n in shard.nodes if n != node.id]
+                if candidates:
+                    plan.append((sub, candidates))
+        if not plan:
+            # nothing replicated these ranges before (fresh key-space)
+            fetch_ranges.fetched(ranges)
+            return au.success_result()
+
+        store = self
+        remaining = {"n": len(plan)}
+
+        def fetch_slice(sub: Ranges, candidates, i: int) -> None:
+            class FetchCallback(Callback):
+                def on_success(self, from_node: int, reply) -> None:
+                    if not isinstance(reply, FetchStoreDataOk):
+                        self.on_failure(from_node,
+                                        RuntimeError(f"bad reply {reply!r}"))
+                        return
+                    for key, entries in reply.entries.items():
+                        for ts, value in entries:
+                            store.append(key, ts, value)
+                    remaining["n"] -= 1
+                    if remaining["n"] == 0:
+                        fetch_ranges.fetched(ranges)
+
+                def on_failure(self, from_node: int, failure: BaseException) -> None:
+                    if i + 1 < len(candidates):
+                        fetch_slice(sub, candidates, i + 1)
+                    else:
+                        fetch_ranges.fail(failure)
+
+            node.send(candidates[i], FetchStoreData(sub), FetchCallback())
+
+        for sub, candidates in plan:
+            fetch_slice(sub, candidates, 0)
         return au.success_result()
 
 

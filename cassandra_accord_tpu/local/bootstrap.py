@@ -1,0 +1,113 @@
+"""Bootstrap: adoption of newly-replicated ranges on topology change.
+
+Capability parity with ``accord.local.Bootstrap`` (Bootstrap.java:83-494, doc :51-82):
+when a topology change gives a command store ranges it did not previously replicate,
+the store must (1) fence the past with a coordinated **exclusive sync point** over the
+new ranges, (2) fetch the data those ranges held before (``DataStore.fetch`` from
+prior-epoch replicas, complete up to the sync point since sources applied it),
+(3) mark ``bootstrapped_at`` in RedundantBefore — older dependencies are then
+implicitly satisfied by the fetched snapshot — and re-evaluate any transactions that
+were waiting on pre-bootstrap dependencies.  Until then the ranges are marked
+pending so reads are refused (served by other replicas) while writes apply normally.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, List
+
+from ..primitives.keys import Ranges
+from ..utils import async_ as au
+
+if TYPE_CHECKING:
+    from .command_store import CommandStore
+    from .node import Node
+
+
+class Bootstrap:
+    """One bootstrap attempt for one store's added ranges at one epoch."""
+
+    def __init__(self, node: "Node", store: "CommandStore", ranges: Ranges,
+                 epoch: int):
+        self.node = node
+        self.store = store
+        self.ranges = ranges
+        self.epoch = epoch
+        self.result = au.settable()
+
+    def start(self) -> au.AsyncResult:
+        self.store.pending_bootstrap = self.store.pending_bootstrap.union(self.ranges)
+        sp_result = self.node.sync_point(self.ranges, exclusive=True, blocking=True)
+        sp_result.add_listener(self._on_sync_point)
+        return self.result
+
+    def _on_sync_point(self, sync_point, failure) -> None:
+        if failure is not None:
+            # retry ladder (Bootstrap.Attempt): the agent decides; default retries
+            def retry():
+                self.node.scheduler.once(
+                    0.5, lambda: self.node.sync_point(self.ranges, exclusive=True,
+                                                      blocking=True)
+                    .add_listener(self._on_sync_point))
+            self.node.agent.on_failed_bootstrap("sync point", self.ranges, retry,
+                                                failure)
+            return
+        fetch_done = au.settable()
+        self._fetch(sync_point, fetch_done)
+        fetch_done.add_listener(
+            lambda _v, f: self._on_fetched(sync_point, f))
+
+    def _fetch(self, sync_point, fetch_done: au.Settable) -> None:
+        class FetchRanges:
+            def fetched(self_inner, ranges: Ranges) -> None:
+                if not fetch_done.is_done():
+                    fetch_done.set_success(ranges)
+
+            def fail(self_inner, failure: BaseException) -> None:
+                if not fetch_done.is_done():
+                    fetch_done.set_failure(failure)
+
+        def run(safe_store):
+            self.node.data_store.fetch(self.node, safe_store, self.ranges,
+                                       sync_point, FetchRanges())
+
+        self.store.execute(run)
+
+    def _on_fetched(self, sync_point, failure) -> None:
+        if failure is not None:
+            def retry():
+                self.node.scheduler.once(
+                    0.5, lambda: self._on_sync_point(sync_point, None))
+            self.node.agent.on_failed_bootstrap("fetch", self.ranges, retry, failure)
+            return
+
+        def finish(safe_store):
+            from .durability import RedundantBefore
+            store = self.store
+            store.redundant_before = store.redundant_before.merge(
+                RedundantBefore.of(self.ranges, bootstrapped_at=sync_point.txn_id))
+            store.pending_bootstrap = store.pending_bootstrap.without(self.ranges)
+            _reevaluate_waiting(safe_store)
+            self.result.set_success(sync_point)
+
+        self.store.execute(finish)
+
+
+def _reevaluate_waiting(safe_store) -> None:
+    """Drop now-redundant (pre-bootstrap) deps from every waiting command and
+    try to execute it (Commands re-evaluation after bootstrappedAt advances)."""
+    from . import commands as C
+    store = safe_store.store
+    redundant = store.redundant_before
+    for command in list(store.commands.values()):
+        waiting = command.waiting_on
+        if waiting is None or not waiting.is_waiting():
+            continue
+        deps = command.partial_deps
+        for dep_id in list(waiting.waiting):
+            parts = deps.participants(dep_id) if deps is not None else None
+            if parts is not None and redundant.is_locally_redundant(dep_id, parts):
+                waiting.remove(dep_id, True)
+                dep = safe_store.get_if_exists(dep_id)
+                if dep is not None:
+                    dep.listeners.discard(command.txn_id)
+        if not waiting.is_waiting():
+            C.maybe_execute(safe_store, command, always_notify_listeners=False)

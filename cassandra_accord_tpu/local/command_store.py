@@ -72,6 +72,8 @@ class CommandStore:
         # MaxConflicts (MaxConflicts.java:32): per-range max executeAt of
         # RANGE-domain txns; key-domain maxima come precisely from each cfk
         self.max_conflicts: MaxConflicts = MaxConflicts()
+        # ranges adopted but not yet bootstrapped: reads refused, writes apply
+        self.pending_bootstrap: Ranges = Ranges.EMPTY
 
     # -- ranges -------------------------------------------------------------
     def update_ranges(self, epoch: int, ranges: Ranges) -> None:
@@ -169,25 +171,32 @@ class SafeCommandStore:
           range txns (InMemoryCommandStore range scan fallback :814-900).
         """
         local = self.store.current_ranges()
+        rb = self.store.redundant_before
         if keys is not None:
             for key in keys:
                 rk = key.to_routing() if hasattr(key, "to_routing") else key
                 if not local.contains(rk):
                     continue
+                fence = rb.fence_before(rk)
                 cfk = self.cfk_if_exists(rk)
                 if cfk is not None:
                     cfk.map_reduce_active(before, witnesses, lambda t, _k=key: visit(_k, t))
                 for tid, (rngs, status) in self.store.range_txns.items():
                     if tid < before and status is not InternalStatus.INVALIDATED \
+                            and (fence is None or not tid < fence) \
                             and witnesses(tid) and rngs.contains(rk):
                         visit(key, tid)
         if ranges is not None:
             for rng in ranges:
+                # elide only below the MIN fence over the whole range (a txn may
+                # intersect a sub-interval with a lower fence)
+                fence = rb.min_fence_over(rng)
                 for rk, cfk in self.store.cfks.items():
                     if rng.contains(rk) and local.contains(rk):
                         cfk.map_reduce_active(before, witnesses, lambda t, _rk=rk: visit(_rk, t))
                 for tid, (rngs, status) in self.store.range_txns.items():
                     if tid < before and status is not InternalStatus.INVALIDATED \
+                            and (fence is None or not tid < fence) \
                             and witnesses(tid) and rngs.intersects(rng):
                         visit(rng, tid)
 
@@ -267,12 +276,32 @@ class SafeCommandStore:
 
     def mark_locally_applied_before(self, txn_id: TxnId, ranges: Ranges) -> None:
         """Everything on ``ranges`` before ``txn_id`` has locally applied (fired
-        when an exclusive sync point applies here: it waited on all of it)."""
+        when an exclusive sync point applies here: it waited on all of it).
+        Advancing the fence also prunes the conflict indexes below it — the
+        fence txn stands in for the pruned entries in future deps calcs."""
         from .durability import RedundantBefore
         local = ranges.intersection(self.store.all_ranges())
         if local:
             self.store.redundant_before = self.store.redundant_before.merge(
                 RedundantBefore.of(local, locally_applied_before=txn_id))
+            self._prune_below_fences()
+
+    def _prune_below_fences(self) -> None:
+        """Drop applied/invalidated index entries wholly below their fence."""
+        from .cfk import InternalStatus as IS
+        store = self.store
+        rb = store.redundant_before
+        for txn_id in list(store.range_txns):
+            rngs, status = store.range_txns[txn_id]
+            if status not in (IS.APPLIED, IS.INVALIDATED) or not rngs:
+                continue
+            fences = [rb.min_fence_over(r) for r in rngs]
+            if all(f is not None and txn_id < f for f in fences):
+                del store.range_txns[txn_id]
+        for rk, cfk in store.cfks.items():
+            fence = rb.fence_before(rk)
+            if fence is not None:
+                cfk.prune_applied_before(fence)
 
     def mark_shard_durable(self, txn_id: TxnId, ranges: Ranges) -> None:
         """SetShardDurable: everything on ``ranges`` before ``txn_id`` is durable

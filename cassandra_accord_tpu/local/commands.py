@@ -283,11 +283,24 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
     local_ranges = safe_store.store.all_ranges()
     deps = command.partial_deps.slice(local_ranges) if command.partial_deps is not None else Deps.NONE
     redundant = safe_store.redundant_before()
+    # fast path: any dep below the store-wide minimum LOCAL fence is redundant
+    # without a per-dep participants scan
+    min_fence = None
+    have_fence = True
+    for rng in local_ranges:
+        f = redundant.min_fence_over(rng, local_only=True)
+        if f is None:
+            have_fence = False
+            break
+        min_fence = f if min_fence is None or f < min_fence else min_fence
+    min_fence = min_fence if have_fence else None
     for dep_id in deps.txn_ids():
         if dep_id == command.txn_id:
             continue
         # removeRedundantDependencies (Commands.java:704-705): deps below the
         # locally-redundant bound have applied (or are subsumed by bootstrap)
+        if min_fence is not None and dep_id < min_fence:
+            continue
         dep_parts = deps.participants(dep_id)
         if dep_parts is not None and redundant.is_locally_redundant(dep_id, dep_parts):
             continue

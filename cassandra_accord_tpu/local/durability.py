@@ -55,6 +55,14 @@ class RedundantEntry(NamedTuple):
             _max_ts(self.bootstrapped_at, other.bootstrapped_at),
             _max_ts(self.stale_until_at_least, other.stale_until_at_least))
 
+    def fence(self, local_only: bool = False) -> Optional[TxnId]:
+        """Strongest fence txn of this entry; ``local_only`` restricts to bounds
+        implying LOCAL application (locally-applied / bootstrap)."""
+        out = _max_ts(self.locally_applied_before, self.bootstrapped_at)
+        if not local_only:
+            out = _max_ts(out, self.shard_applied_before)
+        return out
+
 
 class PreBootstrapOrStale(enum.Enum):
     """Classification of a txn vs bootstrap/staleness bounds
@@ -111,6 +119,46 @@ class RedundantBefore:
             if bound is None or not txn_id < bound:
                 return False
         return True
+
+    def fence_before(self, key: RoutingKey) -> Optional[TxnId]:
+        """The strongest fence txn covering ``key``: everything before it is
+        implied-applied here (locally applied / bootstrap / shard-durable
+        exclusive sync point).  Used to elide older deps from scans — the fence
+        itself is contributed as the floor dependency (collectDeps)."""
+        e = self.map.get(key)
+        return e.fence() if e is not None else None
+
+    def min_fence_over(self, rng: Range, local_only: bool = False) -> Optional[TxnId]:
+        """The weakest fence over a whole range (None if any sub-interval has
+        no fence): only txns below THIS may be elided from scans of the range.
+        ``local_only``: consider only bounds implying LOCAL application
+        (locally-applied / bootstrap) — required when DROPPING a dependency
+        wait, since a shard-applied fence does not imply local apply."""
+        fence: Optional[TxnId] = None
+        for e in self.map.values_over(rng.start, rng.end):
+            f = e.fence(local_only) if e is not None else None
+            if f is None:
+                return None
+            fence = f if fence is None or f < fence else fence
+        return fence
+
+    def collect_deps(self, keys, ranges, add) -> None:
+        """Contribute floor dependencies (RedundantBefore.collectDeps,
+        RedundantBefore.java:183-192): for every participant with a fence bound,
+        add the fence txn as a dependency — it transitively covers every elided
+        transaction before it."""
+        if keys is not None:
+            for key in keys:
+                rk = key.to_routing() if hasattr(key, "to_routing") else key
+                fence = self.fence_before(rk)
+                if fence is not None:
+                    add(key, fence)
+        if ranges is not None:
+            for rng in ranges:
+                for e in self.map.values_over(rng.start, rng.end):
+                    fence = e.fence() if e is not None else None
+                    if fence is not None:
+                        add(rng, fence)
 
     def is_shard_redundant(self, txn_id: TxnId, participants) -> bool:
         """True iff ``txn_id`` is below the shard-applied bound at EVERY point

@@ -83,12 +83,50 @@ class Node(ConfigurationService.Listener):
     def on_topology_update(self, topology: "Topology", start_sync: bool) -> au.AsyncResult:
         if self.topology.current_epoch >= topology.epoch and self.topology.current_epoch > 0:
             return au.success_result()
-        ready = self.topology.on_topology_update(topology)
+        first_epoch = self.topology.current_epoch == 0
+        # diff against the PREVIOUS epoch only: a range this node replicated in
+        # some older epoch but not the last one was written without us — it
+        # must re-bootstrap like any fresh adoption
+        prev_epoch = topology.epoch - 1
+        prev_ranges = {store.id: store.ranges_at(prev_epoch)
+                       for store in self.command_stores.all_stores()}
         self.command_stores.update_topology(topology)
         if self._progress_log_factory is not None:
             for store in self.command_stores.all_stores():
                 if isinstance(store.progress_log, type(ProgressLog.NOOP)):
                     store.progress_log = self._progress_log_factory(store)
+
+        # which stores adopted new ranges? (CommandStores.java:402-482)
+        added_per_store = []
+        if not first_epoch:
+            for store in self.command_stores.all_stores():
+                added = store.ranges_at(topology.epoch).without(
+                    prev_ranges.get(store.id, Ranges.EMPTY))
+                # dedup: ranges already being bootstrapped (an earlier epoch's
+                # in-flight attempt) need no second concurrent attempt — under
+                # rapid churn duplicates otherwise stack up unboundedly
+                added = added.without(store.pending_bootstrap)
+                if added:
+                    added_per_store.append((store, added))
+
+        data_ready = au.settable() if added_per_store else None
+
+        def ready_factory(topo):
+            if data_ready is None:
+                return EpochReady.done(topo.epoch)
+            return EpochReady(topo.epoch, data=data_ready, reads=data_ready)
+
+        # register the epoch FIRST: bootstrap coordination needs it
+        ready = self.topology.on_topology_update(topology, ready_factory)
+
+        if added_per_store:
+            from .bootstrap import Bootstrap
+            bootstraps = [Bootstrap(self, store, added, topology.epoch).start()
+                          for store, added in added_per_store]
+            au.all_of([b.to_chain() for b in bootstraps]).begin(
+                lambda _v, f: data_ready.set_failure(f) if f is not None
+                else data_ready.set_success(None))
+
         self.config_service.acknowledge_epoch(ready, start_sync)
         return au.success_result()
 

@@ -113,6 +113,9 @@ class ReadEphemeralTxnData(TxnRequest):
                 node.message_sink.reply_with_unknown_failure(from_node, reply_context,
                                                              failure)
                 return
+            if any(d == "unavailable" for d in datas):
+                node.reply(from_node, reply_context, ReadNack("unavailable"))
+                return
             merged = None
             for d in datas:
                 if d is None:
@@ -138,6 +141,12 @@ def _read_after_deps(safe_store: SafeCommandStore, txn_id: TxnId,
     result = au.settable()
 
     def do_read(s: SafeCommandStore):
+        # data for bootstrapping ranges is incomplete here: refuse so the
+        # coordinator reads another replica (same guard as _read_when_ready)
+        if s.store.pending_bootstrap \
+                and partial_txn.intersects(s.store.pending_bootstrap):
+            result.set_success("unavailable")
+            return
         read_keys = [key for key in partial_txn.keys
                      if local_ranges.contains(key.to_routing()
                                               if hasattr(key, "to_routing") else key)]

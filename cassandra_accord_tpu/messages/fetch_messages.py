@@ -1,0 +1,70 @@
+"""Bootstrap data-fetch messages.
+
+Capability parity with the reference's bootstrap streaming
+(impl/AbstractFetchCoordinator.java FETCH_DATA_REQ handling, ListFetchCoordinator):
+a replica newly adopting ranges pulls their current contents from a replica of the
+previous epoch.  The source replies with its store contents for the ranges; entries
+are (executeAt, value)-timestamped, so application on the destination is idempotent
+and composes with concurrently-arriving Apply traffic.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict, List, Tuple
+
+from ..primitives.keys import Ranges
+from .base import MessageType, Reply, Request
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+
+class FetchStoreDataOk(Reply):
+    """entries: key -> [(executeAt, value), ...] for every key in the ranges."""
+
+    __slots__ = ("entries",)
+
+    def __init__(self, entries: Dict):
+        self.entries = entries
+
+    @property
+    def type(self):
+        return MessageType.FETCH_DATA_RSP
+
+    def __repr__(self):
+        return f"FetchStoreDataOk({len(self.entries)} keys)"
+
+
+class FetchStoreData(Request):
+    """Stream the data-store contents for ``ranges`` to a bootstrapping replica."""
+
+    __slots__ = ("ranges",)
+
+    def __init__(self, ranges: Ranges):
+        self.ranges = ranges
+
+    @property
+    def type(self):
+        return MessageType.FETCH_DATA_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        # a source that is ITSELF still bootstrapping any of these ranges has
+        # incomplete data — refuse so the fetcher tries another source
+        for cmd_store in node.command_stores.all_stores():
+            if cmd_store.pending_bootstrap \
+                    and cmd_store.pending_bootstrap.intersects(self.ranges):
+                node.message_sink.reply_with_unknown_failure(
+                    from_node, reply_context,
+                    RuntimeError("source bootstrapping requested ranges"))
+                return
+        store = node.data_store
+        entries: Dict = {}
+        data = getattr(store, "data", None)
+        if data is not None:
+            for key, values in data.items():
+                rk = key.to_routing() if hasattr(key, "to_routing") else key
+                if self.ranges.contains(rk):
+                    entries[key] = list(values)
+        node.reply(from_node, reply_context, FetchStoreDataOk(entries))
+
+    def __repr__(self):
+        return f"FetchStoreData({self.ranges!r})"

@@ -52,6 +52,9 @@ def calculate_partial_deps(safe_store: SafeCommandStore, txn_id: TxnId,
             builder.add(key_or_range, dep_id)
 
     safe_store.map_reduce_active(keys, ranges, before, txn_id.witnesses, visit)
+    # floor deps: the fence txns standing in for everything elided below them
+    # (RedundantBefore.collectDeps, PreAccept.java:264)
+    safe_store.redundant_before().collect_deps(keys, ranges, visit)
     return builder.build()
 
 
@@ -428,11 +431,19 @@ def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
                  scope: Route, execute_at_hint: Optional[Timestamp]) -> None:
     """Wait per-store for ReadyToExecute, run the read, merge Data, reply ReadOk
     (ReadData.java:57-260 state machine, collapsed to the wait->execute->reply path)."""
-    stores = node.command_stores.intersecting_stores(
-        scope, txn_id.epoch,
-        execute_at_hint.epoch if execute_at_hint is not None else txn_id.epoch)
+    exec_epoch = execute_at_hint.epoch if execute_at_hint is not None else txn_id.epoch
+    stores = node.command_stores.intersecting_stores(scope, txn_id.epoch, exec_epoch)
     if not stores:
         node.reply(from_node, reply_context, ReadNack("no intersecting store"))
+        return
+    # a replica that no longer owns ANY of the scope at the execution epoch
+    # cannot serve this read (topology moved on) — ReadData unavailable
+    covered = Ranges.EMPTY
+    for store in stores:
+        covered = covered.union(store.ranges_at(exec_epoch))
+    parts = scope.participants()
+    if not covered.intersects(parts):
+        node.reply(from_node, reply_context, ReadNack("unavailable"))
         return
 
     chains = [store.submit(lambda s: _read_when_ready(s, txn_id)).flat_map(lambda c: c)
@@ -444,6 +455,9 @@ def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
             return
         if any(d == "nack" for d in datas):
             node.reply(from_node, reply_context, ReadNack("invalidated"))
+            return
+        if any(d == "unavailable" for d in datas):
+            node.reply(from_node, reply_context, ReadNack("unavailable"))
             return
         merged = None
         for d in datas:
@@ -466,7 +480,17 @@ def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncCha
             return True
         if command.save_status.ordinal >= SaveStatus.READY_TO_EXECUTE.ordinal \
                 and not command.save_status.is_truncated:
-            ranges = s.store.current_ranges()
+            # bootstrap in progress: the data for these ranges is incomplete
+            # here — refuse so the coordinator reads another replica
+            # (ReadData unavailable semantics)
+            if command.partial_txn is not None and s.store.pending_bootstrap \
+                    and command.partial_txn.intersects(s.store.pending_bootstrap):
+                result.set_success("unavailable")
+                return True
+            # read against the ranges owned at the EXECUTION epoch (they may
+            # have been dropped in a later one; the data is still here)
+            ranges = s.store.ranges_at(command.execute_at.epoch) \
+                if command.execute_at is not None else s.store.current_ranges()
             read_keys = command.partial_txn.keys.intersection(ranges) \
                 if isinstance(command.partial_txn.keys, Ranges) \
                 else [k for k in command.partial_txn.keys

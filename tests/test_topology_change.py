@@ -1,0 +1,144 @@
+"""Topology change, bootstrap, and epoch machinery on the simulated cluster.
+
+Parity targets: CommandStores.updateTopology (CommandStores.java:402-482),
+Bootstrap.java:83-494 (exclusive sync point fence + DataStore.fetch +
+bootstrappedAt), TopologyManager epoch sync, TopologyRandomizer.java.
+"""
+from cassandra_accord_tpu.harness.cluster import Cluster
+from cassandra_accord_tpu.harness.topology_randomizer import TopologyRandomizer
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.primitives.keys import IntKey, Range
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v):
+    return IntKey(v)
+
+
+def submit_write(cluster, node_id, appends):
+    return cluster.nodes[node_id].coordinate(
+        list_txn([], {k(key): v for key, v in appends.items()}))
+
+
+def test_replica_move_bootstraps_data():
+    """Node 4 takes over node 3's replica: it must fetch existing data and then
+    serve coordinated reads that include it."""
+    topo1 = Topology(1, [Shard(Range(k(0), k(1000)), [1, 2, 3])])
+    cluster = Cluster(topo1, seed=5, extra_nodes=[4])
+    w = submit_write(cluster, 1, {10: "old1", 700: "old2"})
+    assert cluster.run_until(w.is_done)
+    cluster.run_until_idle()
+
+    topo2 = Topology(2, [Shard(Range(k(0), k(1000)), [1, 2, 4])])
+    cluster.update_topology(topo2)
+    cluster.run_until_idle()
+
+    # node 4 bootstrapped: fetched pre-existing data
+    assert cluster.stores[4].get(k(10)) == ("old1",), cluster.stores[4].data
+    assert cluster.stores[4].get(k(700)) == ("old2",)
+    # bootstrapped_at recorded
+    store4 = cluster.nodes[4].command_stores.all_stores()[0]
+    e = store4.redundant_before.entry(k(10).to_routing())
+    assert e is not None and e.bootstrapped_at is not None
+    assert not store4.pending_bootstrap
+
+    # writes + reads keep working across the new topology
+    w2 = submit_write(cluster, 4, {10: "new1"})
+    assert cluster.run_until(w2.is_done)
+    r = cluster.nodes[2].coordinate(list_txn([k(10)], {}))
+    assert cluster.run_until(r.is_done)
+    assert r.value.reads[k(10)] == ("old1", "new1")
+    cluster.run_until_idle()
+    assert cluster.stores[4].get(k(10)) == ("old1", "new1")
+
+
+def test_writes_during_topology_change_not_lost():
+    topo1 = Topology(1, [Shard(Range(k(0), k(1000)), [1, 2, 3])])
+    cluster = Cluster(topo1, seed=9, extra_nodes=[4, 5])
+    results = [submit_write(cluster, 1 + (i % 3), {5: f"a{i}"}) for i in range(4)]
+    # change topology while writes are in flight
+    cluster.update_topology(Topology(2, [Shard(Range(k(0), k(1000)), [1, 4, 5])]))
+    results += [submit_write(cluster, 1 + (i % 3), {5: f"b{i}"}) for i in range(4)]
+    assert cluster.run_until(lambda: all(r.is_done() for r in results),
+                             max_tasks=2_000_000)
+    cluster.run_until_idle()
+    # replicas of the NEW topology agree and contain all 8 values
+    lists = {cluster.stores[n].get(k(5)) for n in (1, 4, 5)}
+    assert len(lists) == 1, lists
+    final = lists.pop()
+    assert sorted(final) == sorted([f"a{i}" for i in range(4)] + [f"b{i}" for i in range(4)]), final
+
+
+def test_split_and_merge_ranges():
+    topo1 = Topology(1, [Shard(Range(k(0), k(1000)), [1, 2, 3])])
+    cluster = Cluster(topo1, seed=13)
+    w = submit_write(cluster, 1, {100: "x", 900: "y"})
+    assert cluster.run_until(w.is_done)
+    # split
+    cluster.update_topology(Topology(2, [
+        Shard(Range(k(0), k(500)), [1, 2, 3]),
+        Shard(Range(k(500), k(1000)), [1, 2, 3])]))
+    cluster.run_until_idle()
+    w2 = submit_write(cluster, 2, {100: "x2", 900: "y2"})
+    assert cluster.run_until(w2.is_done)
+    # merge back
+    cluster.update_topology(Topology(3, [Shard(Range(k(0), k(1000)), [1, 2, 3])]))
+    cluster.run_until_idle()
+    r = cluster.nodes[3].coordinate(list_txn([k(100), k(900)], {}))
+    assert cluster.run_until(r.is_done)
+    assert r.value.reads[k(100)] == ("x", "x2")
+    assert r.value.reads[k(900)] == ("y", "y2")
+
+
+def test_epoch_sync_tracked():
+    topo1 = Topology(1, [Shard(Range(k(0), k(1000)), [1, 2, 3])])
+    cluster = Cluster(topo1, seed=17)
+    cluster.run_until_idle()
+    cluster.update_topology(Topology(2, [Shard(Range(k(0), k(1000)), [1, 2, 3])]))
+    cluster.run_until_idle()
+    for n in cluster.nodes:
+        tm = cluster.nodes[n].topology
+        assert tm.current_epoch == 2
+        assert tm.is_sync_complete(2), f"node {n} epoch 2 not synced"
+
+
+def test_randomized_topology_churn_with_traffic():
+    """Burn-style: continuous writes while the randomizer mutates topology;
+    every write must survive into the final replica sets, consistently."""
+    topo1 = Topology(1, [Shard(Range(k(0), k(1000)), [1, 2, 3])])
+    cluster = Cluster(topo1, seed=21, extra_nodes=[4, 5])
+    randomizer = TopologyRandomizer(cluster, RandomSource(7))
+    results = []
+    state = {"i": 0}
+
+    def submit_some():
+        for _ in range(3):
+            i = state["i"]
+            state["i"] += 1
+            results.append(submit_write(cluster, 1 + (i % 3), {(i * 53) % 997: f"v{i}"}))
+
+    for round_ in range(6):
+        submit_some()
+        deadline = cluster.now_micros + 400_000
+        cluster.run_until(lambda: cluster.now_micros >= deadline, max_tasks=300_000)
+        randomizer.maybe_update_topology()
+    assert cluster.run_until(lambda: all(r.is_done() for r in results),
+                             max_tasks=3_000_000)
+    cluster.run_until_idle(max_tasks=3_000_000)
+
+    final_topo = cluster.topologies[-1]
+    for i in range(state["i"]):
+        key = k((i * 53) % 997)
+        shard = next(s for s in final_topo.shards if s.range.contains(key.to_routing()))
+        variants = {cluster.stores[n].get(key) for n in shard.nodes}
+        assert len(variants) == 1, f"divergence on {key}: {variants}"
+        assert f"v{i}" in variants.pop(), f"write v{i} lost on {key}"
+
+
+def test_burn_with_topology_churn():
+    from cassandra_accord_tpu.harness.burn import run_burn
+    for seed in (2, 5):
+        res = run_burn(seed, ops=100, concurrency=8, topology_churn=True,
+                       churn_interval_s=0.3)
+        assert res.ops_ok == 100, res
