@@ -91,7 +91,8 @@ class _CoordinateSyncPoint(_CoordinateTransaction):
         if tracker.has_fast_path_accepted() and self.txn_id.kind is TxnKind.SYNC_POINT:
             self.execute(ExecutePath.FAST, self.txn_id.as_timestamp(), deps)
         else:
-            self.propose(_Ballot.ZERO, execute_at, deps)
+            self.extend_to_epoch(
+                execute_at, lambda: self.propose(_Ballot.ZERO, execute_at, deps))
 
     def merge_accept_deps(self, deps, accept_oks):
         return deps

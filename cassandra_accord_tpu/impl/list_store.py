@@ -93,7 +93,9 @@ class ListStore(DataStore):
                     else:
                         fetch_ranges.fail(failure)
 
-            node.send(candidates[i], FetchStoreData(sub), FetchCallback())
+            node.send(candidates[i],
+                      FetchStoreData(sub, sync_point.txn_id, sync_point.route),
+                      FetchCallback())
 
         for sub, candidates in plan:
             fetch_slice(sub, candidates, 0)

@@ -9,9 +9,10 @@ and composes with concurrently-arriving Apply traffic.
 """
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict, List, Tuple
+from typing import TYPE_CHECKING, Dict, Optional
 
 from ..primitives.keys import Ranges
+from ..primitives.timestamp import TxnId
 from .base import MessageType, Reply, Request
 
 if TYPE_CHECKING:
@@ -35,16 +36,25 @@ class FetchStoreDataOk(Reply):
 
 
 class FetchStoreData(Request):
-    """Stream the data-store contents for ``ranges`` to a bootstrapping replica."""
+    """Stream the data-store contents for ``ranges`` to a bootstrapping replica.
+    The source first waits until the fencing sync point has applied LOCALLY
+    (ApplyThenWaitUntilApplied semantics): a source lagging behind the fence
+    would otherwise serve a snapshot missing quorum-applied writes."""
 
-    __slots__ = ("ranges",)
+    __slots__ = ("ranges", "sync_txn_id", "sync_route")
 
-    def __init__(self, ranges: Ranges):
+    def __init__(self, ranges: Ranges, sync_txn_id: Optional[TxnId] = None,
+                 sync_route=None):
         self.ranges = ranges
+        self.sync_txn_id = sync_txn_id
+        self.sync_route = sync_route
 
     @property
     def type(self):
         return MessageType.FETCH_DATA_REQ
+
+    def wait_for_epoch(self) -> int:
+        return self.sync_txn_id.epoch if self.sync_txn_id is not None else 0
 
     def process(self, node: "Node", from_node: int, reply_context) -> None:
         # a source that is ITSELF still bootstrapping any of these ranges has
@@ -56,15 +66,34 @@ class FetchStoreData(Request):
                     from_node, reply_context,
                     RuntimeError("source bootstrapping requested ranges"))
                 return
-        store = node.data_store
-        entries: Dict = {}
-        data = getattr(store, "data", None)
-        if data is not None:
-            for key, values in data.items():
-                rk = key.to_routing() if hasattr(key, "to_routing") else key
-                if self.ranges.contains(rk):
-                    entries[key] = list(values)
-        node.reply(from_node, reply_context, FetchStoreDataOk(entries))
+
+        def serve(outcome=None, failure=None) -> None:
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(from_node,
+                                                             reply_context, failure)
+                return
+            if outcome == "nack":
+                node.message_sink.reply_with_unknown_failure(
+                    from_node, reply_context,
+                    RuntimeError("fence sync point invalidated"))
+                return
+            store = node.data_store
+            entries: Dict = {}
+            data = getattr(store, "data", None)
+            if data is not None:
+                for key, values in data.items():
+                    rk = key.to_routing() if hasattr(key, "to_routing") else key
+                    if self.ranges.contains(rk):
+                        entries[key] = list(values)
+            node.reply(from_node, reply_context, FetchStoreDataOk(entries))
+
+        if self.sync_txn_id is None or self.sync_route is None:
+            serve()
+            return
+        from .txn_messages import await_applied_local
+        await_applied_local(node, self.sync_txn_id, self.sync_route,
+                            self.sync_txn_id.epoch, self.sync_txn_id.epoch) \
+            .begin(lambda outcome, f: serve(outcome, f))
 
     def __repr__(self):
         return f"FetchStoreData({self.ranges!r})"

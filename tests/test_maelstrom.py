@@ -1,0 +1,169 @@
+"""Maelstrom adapter: codec round-trips, in-process simulator, stdio binary.
+
+Parity targets: accord-maelstrom Json.java (full wire codec), Main.java serve loop,
+maelstrom/Cluster.java (random delays + partitions), Runner/SimpleRandomTest.
+"""
+import json
+import subprocess
+import sys
+
+import pytest
+
+from cassandra_accord_tpu.maelstrom import codec
+from cassandra_accord_tpu.maelstrom.node import TopologyFactory, parse_txn
+from cassandra_accord_tpu.maelstrom.runner import MaelstromCluster, run_workload
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.primitives.deps import DepsBuilder
+from cassandra_accord_tpu.primitives.keys import IntKey, Range, Ranges
+from cassandra_accord_tpu.primitives.timestamp import (Ballot, Domain, Timestamp,
+                                                       TxnId, TxnKind)
+
+
+def tid(hlc, node=1, kind=TxnKind.WRITE):
+    return TxnId(1, hlc, node, kind, domain=Domain.KEY)
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_codec_primitives_round_trip():
+    for obj in (tid(42), Ballot(1, 7, 3), Timestamp(2, 9, 1),
+                IntKey(5), Range(IntKey(0), IntKey(10)),
+                Ranges.of(Range(IntKey(0), IntKey(10)), Range(IntKey(20), IntKey(30)))):
+        back = codec.loads(codec.dumps(obj))
+        assert repr(back) == repr(obj)
+        assert type(back) is type(obj)
+
+
+def test_codec_deps_round_trip():
+    b = DepsBuilder()
+    b.add(IntKey(5).to_routing(), tid(1))
+    b.add(IntKey(5).to_routing(), tid(2, kind=TxnKind.READ))
+    b.add(Range(IntKey(0), IntKey(100)),
+          TxnId(1, 3, 2, TxnKind.EXCLUSIVE_SYNC_POINT, Domain.RANGE))
+    deps = b.build()
+    back = codec.loads(codec.dumps(deps))
+    assert sorted(map(repr, back.txn_ids())) == sorted(map(repr, deps.txn_ids()))
+
+
+def test_codec_every_txn_pipeline_message():
+    from cassandra_accord_tpu.messages.txn_messages import (
+        Accept, Apply, Commit, PreAccept, PreAcceptOk, ReadOk, WaitUntilApplied)
+    from cassandra_accord_tpu.local.status import SaveStatus
+    from cassandra_accord_tpu.impl.list_store import ListData
+
+    txn = list_txn([IntKey(5)], {IntKey(7): "x"})
+    route = txn.to_route()
+    full = Ranges.of(Range(IntKey(0), IntKey(1000)))
+    partial = txn.slice(full, True)
+    t = tid(11)
+    b = DepsBuilder()
+    b.add(IntKey(5).to_routing(), tid(1))
+    deps = b.build()
+    writes = partial.execute(t, t.as_timestamp(), None)
+    messages = [
+        PreAccept(t, route, 1, partial, 1, route=route),
+        Accept(t, route, 1, Ballot.ZERO, t.as_timestamp(), partial.keys, deps,
+               route=route),
+        Commit(t, route, 1, SaveStatus.STABLE, t.as_timestamp(), partial, deps,
+               read=True, route=route),
+        Apply(t, route, 1, Apply.MINIMAL, t.as_timestamp(), deps, partial,
+              writes, None, route=route),
+        WaitUntilApplied(t, route, 1),
+        PreAcceptOk(t, t.as_timestamp(), deps),
+        ReadOk(ListData({IntKey(5): ("a", "b")})),
+    ]
+    for m in messages:
+        s = codec.dumps(m)
+        back = codec.loads(s)
+        assert type(back) is type(m), (type(back), type(m))
+        if hasattr(m, "txn_id"):
+            assert back.txn_id == m.txn_id
+
+
+def test_codec_recovery_and_status_messages():
+    from cassandra_accord_tpu.messages.recovery_messages import BeginRecovery
+    from cassandra_accord_tpu.messages.status_messages import (CheckStatus,
+                                                               CheckStatusOk)
+    from cassandra_accord_tpu.local.command import Command
+    txn = list_txn([IntKey(5)], {})
+    route = txn.to_route()
+    t = tid(13)
+    partial = txn.slice(Ranges.of(Range(IntKey(0), IntKey(1000))), True)
+    m = BeginRecovery(t, route, 1, partial, Ballot(1, 5, 2), route=route)
+    back = codec.loads(codec.dumps(m))
+    assert back.txn_id == t and back.ballot == m.ballot
+    cs = CheckStatus(t, route, 1)
+    back2 = codec.loads(codec.dumps(cs))
+    assert back2.txn_id == t
+    ok = CheckStatusOk.of(t, Command(t), Ranges.EMPTY)
+    back3 = codec.loads(codec.dumps(ok))
+    assert back3.save_status is ok.save_status
+
+
+# ---------------------------------------------------------------------------
+# topology factory + txn parsing
+# ---------------------------------------------------------------------------
+
+def test_topology_factory():
+    topo = TopologyFactory.build(["n1", "n2", "n3"])
+    assert topo.size == 3
+    assert topo.nodes() == frozenset({1, 2, 3})
+    for shard in topo.shards:
+        assert len(shard.nodes) == 3
+    # keys anywhere in the int space land in exactly one shard
+    for v in (0, 1, 17, 10**5):
+        assert sum(1 for s in topo.shards if s.range.contains(IntKey(v).to_routing())) == 1
+
+
+def test_parse_txn_multi_append():
+    txn, ops = parse_txn([["r", 1, None], ["append", 1, "a"], ["append", 1, "b"]])
+    assert txn.is_write()
+    from cassandra_accord_tpu.maelstrom.node import MULTI, flatten
+    appends = txn.update.appends
+    assert flatten(tuple(appends.values())) == ["a", "b"]
+
+
+# ---------------------------------------------------------------------------
+# in-process simulator
+# ---------------------------------------------------------------------------
+
+def test_runner_benign_network():
+    out = run_workload(1, n_nodes=3, ops=40, partition_interval_s=None)
+    assert out["ok"] == 40
+
+
+def test_runner_with_partitions():
+    for seed in (2, 9):
+        out = run_workload(seed, n_nodes=5, ops=40, partition_interval_s=1.5)
+        assert out["ok"] == 40
+
+
+# ---------------------------------------------------------------------------
+# stdio binary
+# ---------------------------------------------------------------------------
+
+def test_stdio_single_node():
+    lines = [
+        {"src": "c1", "dest": "n1",
+         "body": {"type": "init", "msg_id": 1, "node_id": "n1", "node_ids": ["n1"]}},
+        {"src": "c1", "dest": "n1",
+         "body": {"type": "txn", "msg_id": 2,
+                  "txn": [["append", 5, 1], ["r", 5, None]]}},
+        {"src": "c1", "dest": "n1",
+         "body": {"type": "txn", "msg_id": 3,
+                  "txn": [["append", 5, 2], ["r", 5, None]]}},
+    ]
+    proc = subprocess.run(
+        [sys.executable, "-m", "cassandra_accord_tpu.maelstrom"],
+        input="\n".join(json.dumps(l) for l in lines) + "\n",
+        capture_output=True, text=True, timeout=60)
+    replies = [json.loads(l) for l in proc.stdout.splitlines()
+               if '"dest":"c1"' in l or '"dest": "c1"' in l]
+    by_reply = {r["body"].get("in_reply_to"): r["body"] for r in replies}
+    assert by_reply[1]["type"] == "init_ok"
+    assert by_reply[2]["type"] == "txn_ok"
+    assert by_reply[2]["txn"][1] == ["r", 5, []]
+    assert by_reply[3]["type"] == "txn_ok"
+    assert by_reply[3]["txn"][1] == ["r", 5, [1]]
