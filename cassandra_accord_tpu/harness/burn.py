@@ -49,7 +49,10 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              key_count: Optional[int] = None, num_shards: int = 1,
              allow_failures: bool = False,
              topology_churn: bool = False,
-             churn_interval_s: float = 1.0) -> BurnResult:
+             churn_interval_s: float = 1.0,
+             delayed_stores: bool = False,
+             clock_drift: bool = False,
+             journal: bool = False) -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation."""
     rng = RandomSource(seed)
     rf = rf if rf is not None else rng.pick([3, 3, 5])
@@ -69,7 +72,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     topology = Topology(1, shards)
 
     cluster = Cluster(topology, seed=rng.next_long(), num_shards=num_shards,
-                      link_config=link_config)
+                      link_config=link_config, delayed_stores=delayed_stores,
+                      clock_drift=clock_drift, journal=journal)
     member_ids = sorted(cluster.nodes)  # nodes actually replicating some shard
     churn_task = None
     if topology_churn:
@@ -165,6 +169,25 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                             f"replica divergence on {key}: {sorted(variants)}")
                 final[key] = longest
         verifier.verify(final)
+        # persistence contract: the journal's diff log must reconstruct every
+        # store's durable command state (Journal.java reconstruct)
+        if cluster.journal is not None:
+            for node in cluster.nodes.values():
+                for store in node.command_stores.all_stores():
+                    cluster.journal.verify_against(store)
     except BaseException as e:  # noqa: BLE001
         raise SimulationException(seed, e) from e
     return result
+
+
+def reconcile(seed: int, **kwargs) -> None:
+    """Run the same seed twice and assert identical observable behavior —
+    catches nondeterminism itself (BurnTest.reconcile, ReconcilingLogger)."""
+    a = run_burn(seed, **kwargs)
+    b = run_burn(seed, **kwargs)
+    assert (a.ops_ok, a.ops_failed, a.sim_micros) == \
+           (b.ops_ok, b.ops_failed, b.sim_micros), \
+        f"nondeterministic outcome for seed {seed}: {a} vs {b}"
+    assert a.stats == b.stats, \
+        f"nondeterministic message counts for seed {seed}: " \
+        f"{ {k: (a.stats.get(k), b.stats.get(k)) for k in set(a.stats) | set(b.stats) if a.stats.get(k) != b.stats.get(k)} }"

@@ -286,6 +286,32 @@ class SimConfigService(ConfigurationService):
         ready.reads.add_listener(lambda v, f: broadcast())
 
 
+class DelayedAgentExecutor:
+    """Store executor adding a random queue delay to every task, simulating
+    storage/executor latency and forcing interleavings
+    (DelayedCommandStores.DelayedCommandStore, DelayedCommandStores.java:138-195)."""
+
+    def __init__(self, agent: Agent, queue: PendingQueue, rng: RandomSource,
+                 max_delay_us: int = 1_000):
+        self.agent = agent
+        self.queue = queue
+        self.rng = rng
+        self.max_delay_us = max_delay_us
+
+    def execute(self, task: Callable[[], None]) -> None:
+        def run():
+            try:
+                task()
+            except BaseException as e:  # noqa: BLE001
+                self.agent.on_uncaught_exception(e)
+
+        self.queue.add_after(self.rng.next_int(self.max_delay_us + 1), run)
+
+    def submit(self, task: Callable[[], object]):
+        from ..utils import async_ as au
+        return au.of_callable(task, executor=self)
+
+
 class SimAgent(Agent):
     def __init__(self, cluster: "Cluster"):
         self.cluster = cluster
@@ -309,7 +335,10 @@ class Cluster:
                  reply_timeout_s: float = 2.0,
                  progress_log: bool = False,
                  progress_poll_s: float = 0.5,
-                 extra_nodes: Optional[List[int]] = None):
+                 extra_nodes: Optional[List[int]] = None,
+                 delayed_stores: bool = False,
+                 clock_drift: bool = False,
+                 journal: bool = False):
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
         self.scheduler = SimScheduler(self.queue)
@@ -321,22 +350,57 @@ class Cluster:
         self.nodes: Dict[int, Node] = {}
         self.sinks: Dict[int, SimMessageSink] = {}
         self.stores: Dict[int, ListStore] = {}
+        self.journal = None
         plf = None
         if progress_log:
             from ..impl.progress_log import progress_log_factory
             plf = progress_log_factory(progress_poll_s)
         agent = SimAgent(self)
+        # per-node clock drift (FrequentLargeRange nowSupplier, BurnTest:329-339)
+        self.clock_offsets: Dict[int, int] = {}
         for node_id in sorted(set(topology.nodes()) | set(extra_nodes or ())):
             sink = SimMessageSink(node_id, self)
             store = ListStore(node_id)
             self.sinks[node_id] = sink
             self.stores[node_id] = store
+            executor_factory = None
+            if delayed_stores:
+                exec_rng = self.rng.fork()
+                executor_factory = (lambda rng: (lambda i: DelayedAgentExecutor(
+                    agent, self.queue, rng.fork())))(exec_rng)
             self.nodes[node_id] = Node(
                 node_id, sink, SimConfigService(self, node_id), agent,
                 self.scheduler, store, self.rng.fork(),
-                now_micros=lambda: self.queue.now_micros,
+                now_micros=(lambda nid: (lambda: self.queue.now_micros
+                                         + self.clock_offsets.get(nid, 0)))(node_id),
                 num_shards=num_shards,
+                executor_factory=executor_factory,
                 progress_log_factory=plf)
+            if clock_drift:
+                self._start_drift(node_id)
+        if journal:
+            from .journal import Journal
+            self.journal = Journal()
+            for node in self.nodes.values():
+                for store in node.command_stores.all_stores():
+                    self.journal.attach(store)
+
+    def _start_drift(self, node_id: int) -> None:
+        """Random-walk clock drift: small 50µs-5ms jumps, occasional 1-10ms
+        large jumps (BurnTest.java:329-339 FrequentLargeRange)."""
+        rng = self.rng.fork()
+
+        def jump():
+            if rng.next_float() < 0.1:
+                delta = rng.next_int(1_000, 10_000)
+            else:
+                delta = rng.next_int(50, 5_000)
+            # drift forward or back, but never behind real sim time
+            off = self.clock_offsets.get(node_id, 0)
+            off += delta if rng.next_boolean() else -delta
+            self.clock_offsets[node_id] = max(0, off)
+
+        self.scheduler.recurring(0.05, jump)
 
     # -- topology change -----------------------------------------------------
     def update_topology(self, new_topology: Topology) -> None:

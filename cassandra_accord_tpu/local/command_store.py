@@ -74,6 +74,8 @@ class CommandStore:
         self.max_conflicts: MaxConflicts = MaxConflicts()
         # ranges adopted but not yet bootstrapped: reads refused, writes apply
         self.pending_bootstrap: Ranges = Ranges.EMPTY
+        # optional persistence hook (harness Journal; simulated durability)
+        self.journal = None
 
     # -- ranges -------------------------------------------------------------
     def update_ranges(self, epoch: int, ranges: Ranges) -> None:
@@ -246,6 +248,12 @@ class SafeCommandStore:
                 if local.contains(rk):
                     self.cfk(rk).update(command.txn_id, status, ea)
 
+    def journal_save(self, command: Command) -> None:
+        """Record the command's durable state in the attached journal (no-op
+        without one) — the persistence contract hook (impl/basic/Journal)."""
+        if self.store.journal is not None:
+            self.store.journal.save(self.store, command)
+
     # -- listeners -----------------------------------------------------------
     def add_transient_listener(self, txn_id: TxnId, callback: Callable) -> None:
         self.store.transient_listeners.setdefault(txn_id, []).append(callback)
@@ -341,6 +349,8 @@ class SafeCommandStore:
                     # invalidated txns can only ever be re-invalidated
                     del store.commands[txn_id]
                     store.transient_listeners.pop(txn_id, None)
+                    if store.journal is not None:
+                        store.journal.erase(store, txn_id)
                     continue
             C.truncate(self, cmd, cleanup)
         # prune conflict indexes below the shard-applied bound per key

@@ -83,6 +83,7 @@ def preaccept(safe_store: SafeCommandStore, txn_id: TxnId, partial_txn: PartialT
     command.set_save_status(SaveStatus.PRE_ACCEPTED)
     safe_store.register_witness(command, InternalStatus.PREACCEPTED)
     safe_store.progress_log().pre_accepted(command, _is_progress_shard(safe_store, command))
+    safe_store.journal_save(command)
     safe_store.notify_listeners(command)
     return AcceptOutcome.SUCCESS
 
@@ -132,6 +133,7 @@ def accept(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: R
     command.set_save_status(SaveStatus.ACCEPTED)
     safe_store.register_witness(command, InternalStatus.ACCEPTED)
     safe_store.progress_log().accepted(command, _is_progress_shard(safe_store, command))
+    safe_store.journal_save(command)
     safe_store.notify_listeners(command)
     return AcceptOutcome.SUCCESS
 
@@ -149,6 +151,7 @@ def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballo
     command.promised = command.promised.merge_max(ballot)
     if command.save_status < SaveStatus.ACCEPTED_INVALIDATE:
         command.set_save_status(SaveStatus.ACCEPTED_INVALIDATE)
+    safe_store.journal_save(command)
     safe_store.notify_listeners(command)
     return AcceptOutcome.SUCCESS
 
@@ -172,6 +175,7 @@ def precommit(safe_store: SafeCommandStore, txn_id: TxnId, execute_at: Timestamp
         return CommitOutcome.REDUNDANT
     command.execute_at = execute_at
     command.set_save_status(SaveStatus.PRE_COMMITTED)
+    safe_store.journal_save(command)
     safe_store.progress_log().precommitted(command)
     safe_store.notify_listeners(command)
     return CommitOutcome.SUCCESS
@@ -210,6 +214,7 @@ def commit(safe_store: SafeCommandStore, txn_id: TxnId, save_status: SaveStatus,
     command.set_save_status(save_status)
     safe_store.register_witness(command, InternalStatus.COMMITTED if save_status is SaveStatus.COMMITTED
                                 else InternalStatus.STABLE)
+    safe_store.journal_save(command)
     if save_status is SaveStatus.STABLE:
         initialise_waiting_on(safe_store, command)
         safe_store.progress_log().stable(command, _is_progress_shard(safe_store, command))
@@ -229,6 +234,7 @@ def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId) -> None:
     if command.save_status is SaveStatus.INVALIDATED:
         return
     command.set_save_status(SaveStatus.INVALIDATED)
+    safe_store.journal_save(command)
     safe_store.register_witness(command, InternalStatus.INVALIDATED)
     safe_store.progress_log().invalidated(command, _is_progress_shard(safe_store, command))
     safe_store.notify_listeners(command)
@@ -263,6 +269,7 @@ def apply_(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
     if command.waiting_on is None:
         initialise_waiting_on(safe_store, command)
     command.set_save_status(SaveStatus.PRE_APPLIED)
+    safe_store.journal_save(command)
     safe_store.register_witness(command, InternalStatus.COMMITTED)
     maybe_execute(safe_store, command, always_notify_listeners=True)
     return CommitOutcome.SUCCESS
@@ -383,6 +390,7 @@ def _apply_writes(safe_store: SafeCommandStore, command: Command) -> None:
             safe_store.agent().on_uncaught_exception(failure)
             return
         command.set_save_status(SaveStatus.APPLIED)
+        safe_store.journal_save(command)
         safe_store.register_witness(command, InternalStatus.APPLIED)
         # an applied exclusive sync point waited on everything before it on its
         # ranges: all of that has now locally applied (RedundantBefore advance)
@@ -435,6 +443,7 @@ def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
         command.writes = None
         command.result = None
         command.set_save_status(SaveStatus.ERASED)
+    safe_store.journal_save(command)
 
 
 # ---------------------------------------------------------------------------
@@ -452,6 +461,7 @@ def set_durability(safe_store: SafeCommandStore, txn_id: TxnId, durability: Dura
     if durability > command.durability:
         command.durability = durability
         safe_store.progress_log().durable(command)
+    safe_store.journal_save(command)   # route/execute_at may have changed too
     return command
 
 

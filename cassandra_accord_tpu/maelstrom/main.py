@@ -125,6 +125,10 @@ def main() -> None:
             line = line.strip()
             if line:
                 handle_line(line.decode())
+        if eof and buf.strip():
+            # final line without a trailing newline still counts
+            handle_line(buf.strip().decode())
+            buf = b""
 
 
 if __name__ == "__main__":

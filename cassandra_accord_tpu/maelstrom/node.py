@@ -28,8 +28,12 @@ MULTI = "$multi"
 
 
 def node_num(name: str) -> int:
-    """Maelstrom node id ("n3") -> accord node id (3)."""
-    return int(name.lstrip("n")) if name.lstrip("n").isdigit() else abs(hash(name)) % 10**6
+    """Maelstrom node id ("n3") -> accord node id (3).  Arbitrary names map via
+    crc32 (process-stable; Python's str hash is salted per process)."""
+    import zlib
+    stripped = name.lstrip("n")
+    return int(stripped) if stripped.isdigit() \
+        else (zlib.crc32(name.encode()) % 10**6) + 10**6
 
 
 class TopologyFactory:

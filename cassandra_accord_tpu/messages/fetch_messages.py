@@ -91,8 +91,12 @@ class FetchStoreData(Request):
             serve()
             return
         from .txn_messages import await_applied_local
+        # span ALL epochs this node knows: the source may hold the ranges only
+        # at a PRIOR epoch (it is the replica the range is moving away from) —
+        # the fence still applies there and must be awaited
         await_applied_local(node, self.sync_txn_id, self.sync_route,
-                            self.sync_txn_id.epoch, self.sync_txn_id.epoch) \
+                            node.topology.min_epoch,
+                            max(self.sync_txn_id.epoch, node.epoch())) \
             .begin(lambda outcome, f: serve(outcome, f))
 
     def __repr__(self):
