@@ -88,7 +88,14 @@ class _CoordinateTransaction:
             def on_failure(self, from_node: int, failure: BaseException) -> None:
                 if self.done:
                     return
-                if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                # a failure can DECIDE the round: an unreachable electorate
+                # member is a fast-path reject, so the tracker may flip to
+                # SUCCESS (slow path) here — not just FAILED
+                status = tracker.record_failure(from_node)
+                if status is RequestStatus.SUCCESS:
+                    self.done = True
+                    this.on_preaccepted(tracker, oks)
+                elif status is RequestStatus.FAILED:
                     self.done = True
                     this.result.set_failure(Exhausted(this.txn_id, "preaccept"))
 

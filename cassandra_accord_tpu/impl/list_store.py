@@ -65,8 +65,21 @@ class ListStore(DataStore):
                 candidates = [n for n in shard.nodes if n != node.id]
                 if candidates:
                     plan.append((sub, candidates))
+                elif node.id in shard.nodes:
+                    # we were the shard's only replica: our local copy IS the
+                    # data, complete up to the fence by construction
+                    pass
+                else:
+                    # a needed slice has NO source — reporting it fetched would
+                    # let bootstrapped_at cover data we never obtained; fail the
+                    # attempt so bootstrap retries (ListStore.fetch contract,
+                    # impl/list/ListStore.java)
+                    fetch_ranges.fail(RuntimeError(
+                        f"no fetch source for {sub!r} (prior epoch {prior.epoch})"))
+                    return au.success_result()
+        # anything the prior topology did not replicate at all is fresh
+        # key-space: trivially complete
         if not plan:
-            # nothing replicated these ranges before (fresh key-space)
             fetch_ranges.fetched(ranges)
             return au.success_result()
 

@@ -312,13 +312,18 @@ class SafeCommandStore:
                 cfk.prune_applied_before(fence)
 
     def mark_shard_durable(self, txn_id: TxnId, ranges: Ranges) -> None:
-        """SetShardDurable: everything on ``ranges`` before ``txn_id`` is durable
-        at a quorum (majority watermark) and shard-applied."""
+        """SetShardDurable: the durability round proved (via an all-replica
+        WaitUntilApplied, CoordinateShardDurable.java) that everything on
+        ``ranges`` before ``txn_id`` has applied at EVERY replica — advance
+        both the majority and universal watermarks (matching
+        CommandStore.markShardDurable, CommandStore.java:520-528) and the
+        shard-applied redundancy bound."""
         from .durability import DurableBefore, RedundantBefore
         local = ranges.intersection(self.store.all_ranges())
         if local:
             self.store.durable_before = self.store.durable_before.merge(
-                DurableBefore.of(local, majority_before=txn_id))
+                DurableBefore.of(local, majority_before=txn_id,
+                                 universal_before=txn_id))
             self.store.redundant_before = self.store.redundant_before.merge(
                 RedundantBefore.of(local, shard_applied_before=txn_id))
         self.run_gc()

@@ -21,8 +21,9 @@ if TYPE_CHECKING:
 
 
 class SetShardDurable(Request):
-    """The exclusive sync point ``txn_id`` (covering ``ranges``) applied at a
-    quorum: everything before it on those ranges is majority-durable."""
+    """The exclusive sync point ``txn_id`` (covering ``ranges``) applied at
+    EVERY replica (the durability round's all-replica WaitUntilApplied barrier):
+    everything before it on those ranges is universally durable."""
 
     __slots__ = ("txn_id", "ranges")
 
@@ -51,8 +52,9 @@ class SetShardDurable(Request):
 
 
 class SetGloballyDurable(Request):
-    """Adopt a cluster-wide DurableBefore map (the min every queried node
-    agreed on) — upgrades ranges to universal durability."""
+    """Adopt a cluster-wide DurableBefore map (the MAX-merge of a quorum of
+    nodes' maps — each entry was proved by a completed shard round, so
+    dissemination only spreads established knowledge; no promotion)."""
 
     __slots__ = ("durable_before",)
 

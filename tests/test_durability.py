@@ -6,7 +6,7 @@ CoordinateGloballyDurable, CoordinateDurabilityScheduling.java:78-350.
 """
 from cassandra_accord_tpu.coordinate.durability import (
     coordinate_globally_durable, coordinate_shard_durable)
-from cassandra_accord_tpu.harness.cluster import Cluster
+from cassandra_accord_tpu.harness.cluster import Cluster, LinkConfig
 from cassandra_accord_tpu.impl.durability_scheduling import (
     CoordinateDurabilityScheduling, _split)
 from cassandra_accord_tpu.impl.list_store import list_txn
@@ -119,7 +119,9 @@ def test_shard_durable_round_advances_watermarks_and_truncates():
     assert cluster.run_until(res.is_done)
     cluster.run_until_idle()
 
-    # every replica advanced DurableBefore and truncated the applied writes
+    # every replica advanced DurableBefore (the all-replica round proves
+    # universal durability directly) and GC'd the applied writes: erased
+    # outright or at least truncated
     for n in cluster.nodes:
         for store in cluster.nodes[n].command_stores.all_stores():
             if not store.current_ranges():
@@ -127,9 +129,12 @@ def test_shard_durable_round_advances_watermarks_and_truncates():
             e = store.durable_before.entry(k(10).to_routing())
             assert e is not None and e.majority_before is not None, \
                 f"node {n}: no durability watermark"
-            truncated = [c for c in store.commands.values()
-                         if c.save_status is SaveStatus.TRUNCATED_APPLY]
-            assert truncated, f"node {n}: nothing truncated"
+            assert e.universal_before is not None, \
+                f"node {n}: all-replica round did not prove universal"
+            live = [c for c in store.commands.values()
+                    if c.save_status is SaveStatus.APPLIED
+                    and c.txn_id.kind is TxnKind.WRITE]
+            assert not live, f"node {n}: applied writes never cleaned up: {live}"
 
 
 def test_globally_durable_round_upgrades_to_universal():
@@ -149,7 +154,7 @@ def test_globally_durable_round_upgrades_to_universal():
                 continue
             e = store.durable_before.entry(k(7).to_routing())
             assert e is not None and e.universal_before is not None, \
-                f"node {n}: majority not lifted to universal"
+                f"node {n}: universal watermark not disseminated"
 
 
 def test_new_txns_still_correct_after_gc():
@@ -200,3 +205,72 @@ def test_durability_scheduling_runs_rounds():
     assert ok, "scheduled durability rounds never advanced any watermark"
     for s in scheds:
         s.stop()
+
+
+# ---------------------------------------------------------------------------
+# a replica outside the apply quorum must never lose writes to a concurrent
+# durability round (the round requires ALL replicas to ack application before
+# broadcasting SetShardDurable; CoordinateShardDurable.java AppliedTracker
+# waits shard.rf(), not a quorum)
+# ---------------------------------------------------------------------------
+
+class _PartitionNode(LinkConfig):
+    """Drops every message to/from ``isolated`` while ``active``."""
+
+    def __init__(self, rng, isolated: int):
+        super().__init__(rng)
+        self.isolated = isolated
+        self.active = False
+
+    def action(self, from_node: int, to_node: int, message=None) -> str:
+        if self.active and self.isolated in (from_node, to_node):
+            return LinkConfig.DROP
+        return LinkConfig.DELIVER
+
+
+def test_shard_durable_round_does_not_strand_partitioned_replica():
+    from cassandra_accord_tpu.utils.random import RandomSource
+    link = _PartitionNode(RandomSource(101), isolated=3)
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    cluster = Cluster(Topology(1, shards), seed=13, link_config=link,
+                      progress_log=True)
+
+    # partition node 3, then write: the txns apply at the {1,2} quorum only
+    link.active = True
+    results = [submit_write(cluster, 1, {i: f"v{i}"}) for i in range(4)]
+    assert cluster.run_until(lambda: all(r.is_done() for r in results),
+                             max_tasks=500_000)
+
+    # a durability round concurrent with the partition MUST NOT advance
+    # watermarks: node 3 has not applied, so the all-replica barrier cannot
+    # complete (quorum-gated rounds would broadcast here and let peers ERASE
+    # outcomes node 3 still needs)
+    res = coordinate_shard_durable(cluster.nodes[1], Ranges.of(Range(k(0), k(1000))))
+    assert cluster.run_until(res.is_done, max_tasks=500_000)
+    assert res.failure is not None, "durability round succeeded under partition"
+    for store in cluster.nodes[3].command_stores.all_stores():
+        e = store.durable_before.entry(k(0).to_routing())
+        assert e is None or e.majority_before is None, \
+            "partitioned replica adopted a durability watermark"
+
+    # heal: a fresh durability round's sync point witnesses the old writes as
+    # deps; node 3 blocks on them, and the progress machinery fetches what it
+    # missed — the round only completes once node 3 has actually applied
+    link.active = False
+    res2 = None
+    for _attempt in range(8):  # the scheduling layer retries each cycle
+        res2 = coordinate_shard_durable(cluster.nodes[1],
+                                        Ranges.of(Range(k(0), k(1000))))
+        assert cluster.run_until(res2.is_done, max_tasks=2_000_000)
+        if res2.failure is None:
+            break
+        cluster.run_for(2.0)  # let progress-log fetch/apply catch node 3 up
+    assert res2.failure is None, f"post-heal durability round failed: {res2.failure}"
+    cluster.run_until_idle()
+    # every replica holds identical, complete data
+    lists = {tuple(sorted((key.value, cluster.stores[n].get(key))
+                          for key in map(k, range(4))))
+             for n in cluster.nodes}
+    assert len(lists) == 1, lists
+    for key in map(k, range(4)):
+        assert cluster.stores[1].get(key) == (f"v{key.value}",)
