@@ -52,7 +52,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              churn_interval_s: float = 1.0,
              delayed_stores: bool = False,
              clock_drift: bool = False,
-             journal: bool = False) -> BurnResult:
+             journal: bool = False,
+             resolver: Optional[str] = None) -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation."""
     rng = RandomSource(seed)
     rf = rf if rf is not None else rng.pick([3, 3, 5])
@@ -73,7 +74,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
 
     cluster = Cluster(topology, seed=rng.next_long(), num_shards=num_shards,
                       link_config=link_config, delayed_stores=delayed_stores,
-                      clock_drift=clock_drift, journal=journal)
+                      clock_drift=clock_drift, journal=journal,
+                      resolver=resolver)
     member_ids = sorted(cluster.nodes)  # nodes actually replicating some shard
     churn_task = None
     if topology_churn:

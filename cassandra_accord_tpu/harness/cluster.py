@@ -338,7 +338,8 @@ class Cluster:
                  extra_nodes: Optional[List[int]] = None,
                  delayed_stores: bool = False,
                  clock_drift: bool = False,
-                 journal: bool = False):
+                 journal: bool = False,
+                 resolver: Optional[str] = None):
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
         self.scheduler = SimScheduler(self.queue)
@@ -375,7 +376,8 @@ class Cluster:
                                          + self.clock_offsets.get(nid, 0)))(node_id),
                 num_shards=num_shards,
                 executor_factory=executor_factory,
-                progress_log_factory=plf)
+                progress_log_factory=plf,
+                resolver=resolver)
             if clock_drift:
                 self._start_drift(node_id)
         if journal:

@@ -1,0 +1,194 @@
+"""The DepsResolver boundary — the pluggable per-store conflict-index data plane.
+
+The reference hides its dependency calculation behind
+``SafeCommandStore.mapReduceActive`` (SafeCommandStore.java:292) + the per-key
+``CommandsForKey`` indexes (cfk/CommandsForKey.java:925-1000) and its timestamp
+proposal behind ``MaxConflicts`` (MaxConflicts.java:32) + per-key maxima.  This
+module makes that boundary explicit so the SAME protocol code runs against:
+
+- ``CpuDepsResolver``  — the host reference data plane: walks the store's
+  CommandsForKey lists (exactly the reference's scalar scan shape);
+- ``TpuDepsResolver``  — the device data plane (impl/tpu_resolver.py): the
+  store's conflict index lives on-device as a GraphState and every query is a
+  batched MXU join (ops.deps_kernels.overlap_join / max_conflict_keys);
+- ``VerifyDepsResolver`` — runs both and asserts bit-identical results on
+  every query ("deps-graph parity"); used by tests and the burn harness.
+
+Select per-node via ``Node(resolver=...)`` or globally via the environment
+variable ``ACCORD_RESOLVER`` in {cpu, tpu, verify} (default cpu).
+
+Scope: the resolver owns the KEY-domain conflict index (the hot path).
+Range-domain transactions (sync points; InMemoryCommandStore.rangeCommands
+scan, :814-900) remain a host-side side table in SafeCommandStore — they are
+rare control transactions, not data-plane load.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
+
+from ..primitives.keys import Range, RoutingKey
+from ..primitives.timestamp import Timestamp, TxnId
+from ..utils.invariants import check_state
+
+if TYPE_CHECKING:
+    from ..local.command_store import CommandStore
+    from ..local.cfk import InternalStatus
+
+
+def resolver_kind_from_env() -> str:
+    kind = os.environ.get("ACCORD_RESOLVER", "cpu").lower()
+    check_state(kind in ("cpu", "tpu", "verify"),
+                "ACCORD_RESOLVER must be cpu|tpu|verify, got %s", kind)
+    return kind
+
+
+def make_resolver(kind: str, store: "CommandStore") -> "DepsResolver":
+    if kind == "cpu":
+        return CpuDepsResolver(store)
+    if kind == "tpu":
+        from .tpu_resolver import TpuDepsResolver
+        return TpuDepsResolver(store)
+    if kind == "verify":
+        from .tpu_resolver import TpuDepsResolver
+        return VerifyDepsResolver(CpuDepsResolver(store), TpuDepsResolver(store))
+    raise ValueError(f"unknown resolver kind {kind!r}")
+
+
+class DepsResolver:
+    """Interface.  All queries are pure reads of the index; registration and
+    pruning are the only mutations, and both are driven by the owning
+    SafeCommandStore (single-logical-thread discipline applies)."""
+
+    def register(self, txn_id: TxnId, status: "InternalStatus",
+                 execute_at: Optional[Timestamp],
+                 keys: Tuple[RoutingKey, ...]) -> None:
+        """Witness/upgrade a key-domain managed txn on ``keys``
+        (CommandsForKey.update semantics: status monotonic)."""
+        raise NotImplementedError
+
+    def on_pruned(self, key: RoutingKey, txn_ids: List[TxnId]) -> None:
+        """The per-key index dropped ``txn_ids`` below a prune bound — evict
+        the (txn, key) incidences so late queries match (cfk pruning)."""
+        raise NotImplementedError
+
+    def key_conflicts(self, by: TxnId, keys: List[RoutingKey], before: Timestamp
+                      ) -> List[Tuple[RoutingKey, TxnId]]:
+        """Active (non-invalidated) indexed txns with txnId < before on any of
+        ``keys`` that ``by``'s kind witnesses; (key, dep) per incidence.
+        == mapReduceActive over the cfk indexes (cfk/CommandsForKey.java:925)."""
+        raise NotImplementedError
+
+    def range_conflicts(self, by: TxnId, rng: Range, before: Timestamp
+                        ) -> List[Tuple[RoutingKey, TxnId]]:
+        """Same, for every indexed key inside ``rng``."""
+        raise NotImplementedError
+
+    def max_conflict_keys(self, keys: List[RoutingKey]) -> Optional[Timestamp]:
+        """Lexicographic max of max(executeAt, txnId) over indexed txns touching
+        ``keys`` (the per-key half of the MaxConflicts consult)."""
+        raise NotImplementedError
+
+    def max_conflict_range(self, rng: Range) -> Optional[Timestamp]:
+        raise NotImplementedError
+
+
+class CpuDepsResolver(DepsResolver):
+    """Reference host resolver: delegates to the store's CommandsForKey lists.
+    This IS the reference algorithm (scalar per-key scans); it owns no state of
+    its own, so cfk registration doubles as resolver registration."""
+
+    def __init__(self, store: "CommandStore"):
+        self.store = store
+
+    # cfk.update is already performed by SafeCommandStore.register_witness —
+    # the cfk lists are this resolver's index.
+    def register(self, txn_id, status, execute_at, keys) -> None:
+        pass
+
+    def on_pruned(self, key, txn_ids) -> None:
+        pass
+
+    def key_conflicts(self, by, keys, before):
+        out: List[Tuple[RoutingKey, TxnId]] = []
+        for rk in keys:
+            cfk = self.store.cfks.get(rk)
+            if cfk is not None:
+                cfk.map_reduce_active(before, by.witnesses,
+                                      lambda t, _rk=rk: out.append((_rk, t)))
+        return out
+
+    def range_conflicts(self, by, rng, before):
+        out: List[Tuple[RoutingKey, TxnId]] = []
+        for rk in sorted(self.store.cfks):
+            if rng.contains(rk):
+                cfk = self.store.cfks[rk]
+                cfk.map_reduce_active(before, by.witnesses,
+                                      lambda t, _rk=rk: out.append((_rk, t)))
+        return out
+
+    def max_conflict_keys(self, keys):
+        out: Optional[Timestamp] = None
+        for rk in keys:
+            cfk = self.store.cfks.get(rk)
+            if cfk is not None:
+                ts = cfk.max_timestamp()
+                if ts is not None and (out is None or ts > out):
+                    out = ts
+        return out
+
+    def max_conflict_range(self, rng):
+        out: Optional[Timestamp] = None
+        for rk in sorted(self.store.cfks):
+            if rng.contains(rk):
+                ts = self.store.cfks[rk].max_timestamp()
+                if ts is not None and (out is None or ts > out):
+                    out = ts
+        return out
+
+
+class VerifyDepsResolver(DepsResolver):
+    """Runs the CPU and TPU resolvers side by side and asserts every query
+    agrees — the continuous deps-graph parity check (BASELINE.md metric).
+    Comparison is set-level (Deps construction is order-independent)."""
+
+    def __init__(self, cpu: CpuDepsResolver, tpu: DepsResolver):
+        self.cpu = cpu
+        self.tpu = tpu
+        self.queries = 0
+
+    def register(self, txn_id, status, execute_at, keys) -> None:
+        self.cpu.register(txn_id, status, execute_at, keys)
+        self.tpu.register(txn_id, status, execute_at, keys)
+
+    def on_pruned(self, key, txn_ids) -> None:
+        self.cpu.on_pruned(key, txn_ids)
+        self.tpu.on_pruned(key, txn_ids)
+
+    def _check(self, what, a, b):
+        check_state(a == b, "deps parity violation in %s: cpu=%s tpu=%s",
+                    what, a, b)
+        self.queries += 1
+        return a
+
+    def key_conflicts(self, by, keys, before):
+        return self._check(
+            "key_conflicts",
+            sorted(self.cpu.key_conflicts(by, keys, before)),
+            sorted(self.tpu.key_conflicts(by, keys, before)))
+
+    def range_conflicts(self, by, rng, before):
+        return self._check(
+            "range_conflicts",
+            sorted(self.cpu.range_conflicts(by, rng, before)),
+            sorted(self.tpu.range_conflicts(by, rng, before)))
+
+    def max_conflict_keys(self, keys):
+        return self._check("max_conflict_keys",
+                           self.cpu.max_conflict_keys(keys),
+                           self.tpu.max_conflict_keys(keys))
+
+    def max_conflict_range(self, rng):
+        return self._check("max_conflict_range",
+                           self.cpu.max_conflict_range(rng),
+                           self.tpu.max_conflict_range(rng))

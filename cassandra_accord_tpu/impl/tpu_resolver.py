@@ -1,0 +1,318 @@
+"""TpuDepsResolver — the device-resident conflict-index data plane.
+
+The per-store conflict index (the reference's CommandsForKey sorted arrays +
+MaxConflicts map, cfk/CommandsForKey.java:615-628, MaxConflicts.java:32) lives
+on-device as an ``ops.graph_state.GraphState``: a key-incidence matrix, packed
+timestamp lanes, kind/status codes and an active mask over fixed txn slots.
+
+Every dependency query (``SafeCommandStore.map_reduce_active`` →
+``calculate_partial_deps``, PreAccept.java:245-267) and timestamp-proposal
+consult (``max_conflict``) is answered by a batched MXU join
+(ops.deps_kernels.overlap_join / max_conflict_keys) instead of the reference's
+scalar per-key scans (cfk/CommandsForKey.java:925-1000).
+
+Host/device split:
+- the host keeps O(1)-per-txn bookkeeping: TxnId ↔ slot maps, per-txn key
+  sets (for result attribution), status/executeAt mirrors (for monotonic
+  update rules and capacity-growth rebuilds);
+- the device holds the O(T×K) index and does all O(T) scan work.
+
+Mutations (register / prune) are buffered host-side and flushed to the device
+as batched scatters immediately before the next query, so a burst of
+concurrent PreAccepts between queries becomes one fused device update — the
+batching the dense per-txn Java scan cannot do.
+
+Slot lifecycle: slots are recycled once a txn is fully pruned from every key
+it touched (the cfk prune protocol driven by RedundantBefore GC,
+command_store._prune_below_fences / run_gc); capacity doubles by host rebuild
+when the free list empties.
+"""
+from __future__ import annotations
+
+import heapq
+from typing import Dict, List, Optional, Set, Tuple, TYPE_CHECKING
+
+import numpy as np
+
+from ..primitives.keys import Range, RoutingKey
+from ..primitives.timestamp import Timestamp, TxnId
+from ..utils.invariants import check_state
+from .resolver import DepsResolver
+
+if TYPE_CHECKING:
+    from ..local.command_store import CommandStore
+    from ..local.cfk import InternalStatus
+
+
+def _pack_before(before: Timestamp) -> Tuple[int, int, int, int, int]:
+    """Pack a query bound, saturating out-of-device-range bounds (e.g. the
+    ephemeral-read Timestamp.MAX sentinel) to lanes above every real packed
+    timestamp (all real lanes are < 2^31-1)."""
+    try:
+        return before.pack_lanes()
+    except Exception:  # noqa: BLE001 — bound exceeds device packing range
+        m = 0x7FFFFFFF
+        return (m, m, m, m, m)
+
+
+class _TxnMirror:
+    """Host bookkeeping for one indexed txn (rebuilds + attribution)."""
+    __slots__ = ("slot", "kind_code", "status", "execute_at", "keys")
+
+    def __init__(self, slot: int, kind_code: int, status: int,
+                 execute_at: Timestamp, keys: Set[RoutingKey]):
+        self.slot = slot
+        self.kind_code = kind_code
+        self.status = status
+        self.execute_at = execute_at
+        self.keys = keys
+
+
+class TpuDepsResolver(DepsResolver):
+    def __init__(self, store: "CommandStore", txn_capacity: int = 64,
+                 key_capacity: int = 64):
+        self.store = store
+        self.txns: Dict[TxnId, _TxnMirror] = {}
+        self.txn_at: Dict[int, TxnId] = {}          # slot -> txn (attribution)
+        self.key_slot: Dict[RoutingKey, int] = {}
+        self.key_refs: Dict[RoutingKey, int] = {}   # live incidences per key
+        self.free_slots: List[int] = list(range(txn_capacity))
+        heapq.heapify(self.free_slots)
+        self.free_key_slots: List[int] = list(range(key_capacity))
+        heapq.heapify(self.free_key_slots)
+        # pending (txn_id) inserts/updates and (slot, key_slot) bit ops
+        self._dirty_txns: Set[TxnId] = set()
+        self._clear_bits: List[Tuple[int, int]] = []
+        self._deactivate: List[int] = []
+        self._state = None          # lazy: GraphState built on first flush
+        self._t = txn_capacity
+        self._k = key_capacity
+
+    # -- registration (cfk.update semantics) ---------------------------------
+    def register(self, txn_id: TxnId, status, execute_at, keys) -> None:
+        from ..local.cfk import InternalStatus as IS
+        status_i = int(status)
+        m = self.txns.get(txn_id)
+        if m is None:
+            slot = self._alloc_slot()
+            ea = execute_at if execute_at is not None else txn_id.as_timestamp()
+            m = _TxnMirror(slot, int(txn_id.kind), status_i, ea, set())
+            self.txns[txn_id] = m
+            self.txn_at[slot] = txn_id
+        else:
+            # monotonic status; executeAt moves on upgrade or while ACCEPTED
+            if status_i > m.status:
+                m.status = status_i
+                if execute_at is not None:
+                    m.execute_at = execute_at
+            elif status_i == m.status and execute_at is not None \
+                    and status_i == int(IS.ACCEPTED):
+                m.execute_at = execute_at
+        for rk in keys:
+            if rk not in m.keys:
+                # allocate the key slot BEFORE recording the incidence: growth
+                # rebuilds iterate txn key sets and need every slot assigned
+                if rk not in self.key_slot:
+                    self.key_slot[rk] = self._alloc_key_slot()
+                m.keys.add(rk)
+                self.key_refs[rk] = self.key_refs.get(rk, 0) + 1
+        self._dirty_txns.add(txn_id)
+
+    def on_pruned(self, key: RoutingKey, txn_ids) -> None:
+        ks = self.key_slot.get(key)
+        if ks is None:
+            return
+        for txn_id in txn_ids:
+            m = self.txns.get(txn_id)
+            if m is None or key not in m.keys:
+                continue
+            m.keys.discard(key)
+            self._clear_bits.append((m.slot, ks))
+            self._release_key(key)
+            if not m.keys:
+                # fully pruned: recycle the slot
+                self._deactivate.append(m.slot)
+                del self.txns[txn_id]
+                del self.txn_at[m.slot]
+                self._dirty_txns.discard(txn_id)
+                heapq.heappush(self.free_slots, m.slot)
+
+    def _release_key(self, key: RoutingKey) -> None:
+        """Drop a live incidence; recycle the key slot when none remain (the
+        device column is already zeroed by the per-incidence clears)."""
+        n = self.key_refs.get(key, 0) - 1
+        if n > 0:
+            self.key_refs[key] = n
+        else:
+            self.key_refs.pop(key, None)
+            ks = self.key_slot.pop(key, None)
+            if ks is not None:
+                heapq.heappush(self.free_key_slots, ks)
+
+    # -- queries -------------------------------------------------------------
+    def key_conflicts(self, by: TxnId, keys, before: Timestamp):
+        import jax.numpy as jnp
+        from ..ops import deps_kernels as dk
+        known = [rk for rk in keys if rk in self.key_slot]
+        if not known or not self.txns:
+            return []
+        self._flush()
+        q = np.zeros((1, self._k), dtype=np.int8)
+        for rk in known:
+            q[0, self.key_slot[rk]] = 1
+        before_lanes = np.asarray([_pack_before(before)], dtype=np.int32)
+        kind = np.asarray([int(by.kind)], dtype=np.int8)
+        s = self._state
+        mask = np.asarray(dk.overlap_join(
+            s.key_inc, s.txn_id, s.kind, s.status, s.active,
+            jnp.asarray(q), jnp.asarray(before_lanes), jnp.asarray(kind)))[0]
+        return self._attribute(mask, set(known))
+
+    def range_conflicts(self, by: TxnId, rng: Range, before: Timestamp):
+        keys = [rk for rk in self.key_slot if rng.contains(rk)]
+        return self.key_conflicts(by, keys, before)
+
+    def max_conflict_keys(self, keys) -> Optional[Timestamp]:
+        import jax.numpy as jnp
+        from ..ops import deps_kernels as dk
+        known = [rk for rk in keys if rk in self.key_slot]
+        if not known or not self.txns:
+            return None
+        self._flush()
+        q = np.zeros((1, self._k), dtype=np.int8)
+        for rk in known:
+            q[0, self.key_slot[rk]] = 1
+        s = self._state
+        lanes = np.asarray(dk.max_conflict_keys(
+            s.key_inc, s.ts, s.txn_id, s.active, jnp.asarray(q)))[0]
+        ts = Timestamp.unpack_lanes(tuple(int(v) for v in lanes))
+        return None if ts == Timestamp.NONE else ts
+
+    def max_conflict_range(self, rng: Range) -> Optional[Timestamp]:
+        keys = [rk for rk in self.key_slot if rng.contains(rk)]
+        return self.max_conflict_keys(keys)
+
+    # -- device state management ---------------------------------------------
+    def _attribute(self, mask: np.ndarray, queried: Set[RoutingKey]
+                   ) -> List[Tuple[RoutingKey, TxnId]]:
+        """Map a [T] slot mask back to (key, TxnId) incidences.  O(|result|):
+        the device did the O(T) scan; the host only touches hits."""
+        out: List[Tuple[RoutingKey, TxnId]] = []
+        for slot in np.nonzero(mask)[0]:
+            tid = self.txn_at.get(int(slot))
+            if tid is None:
+                continue
+            for rk in self.txns[tid].keys & queried:
+                out.append((rk, tid))
+        return out
+
+    def _alloc_slot(self) -> int:
+        if not self.free_slots:
+            self._grow(txns=True)
+        return heapq.heappop(self.free_slots)
+
+    def _alloc_key_slot(self) -> int:
+        if not self.free_key_slots:
+            self._grow(txns=False)
+        return heapq.heappop(self.free_key_slots)
+
+    def _grow(self, txns: bool) -> None:
+        """Double capacity and rebuild the device state from host mirrors."""
+        if txns:
+            self.free_slots = list(range(self._t, self._t * 2))
+            heapq.heapify(self.free_slots)
+            self._t *= 2
+        else:
+            self.free_key_slots = list(range(self._k, self._k * 2))
+            heapq.heapify(self.free_key_slots)
+            self._k *= 2
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        """Full host->device rebuild (capacity growth only — rare, amortised)."""
+        from ..ops import graph_state as gs
+        import jax.numpy as jnp
+        t, k = self._t, self._k
+        key_inc = np.zeros((t, k), dtype=np.int8)
+        ts = np.zeros((t, gs.TS_LANES), dtype=np.int32)
+        txn_id = np.zeros((t, gs.TS_LANES), dtype=np.int32)
+        kind = np.zeros((t,), dtype=np.int8)
+        status = np.zeros((t,), dtype=np.int8)
+        active = np.zeros((t,), dtype=np.bool_)
+        for tid, m in self.txns.items():
+            key_inc[m.slot, [self.key_slot[rk] for rk in m.keys]] = 1
+            ts[m.slot] = m.execute_at.pack_lanes()
+            txn_id[m.slot] = tid.pack_lanes()
+            kind[m.slot] = m.kind_code
+            status[m.slot] = m.status
+            active[m.slot] = True
+        self._state = gs.GraphState(
+            key_inc=jnp.asarray(key_inc), ts=jnp.asarray(ts),
+            txn_id=jnp.asarray(txn_id), kind=jnp.asarray(kind),
+            status=jnp.asarray(status),
+            adj=jnp.zeros((t, t), dtype=jnp.int8),
+            active=jnp.asarray(active))
+        self._dirty_txns.clear()
+        self._clear_bits.clear()
+        self._deactivate.clear()
+
+    def _flush(self) -> None:
+        """Push buffered mutations to the device as batched scatters (eager
+        jnp ops: no per-batch-size recompilation; one fused update per burst)."""
+        from ..ops import graph_state as gs
+        import jax.numpy as jnp
+        if self._state is None:
+            self._rebuild()
+            return
+        if not (self._dirty_txns or self._clear_bits or self._deactivate):
+            return
+        s = self._state
+        # order matters: clears and deactivations target OLD occupants of a
+        # slot; inserts (which may recycle that same slot) must land last
+        if self._clear_bits:
+            rows = np.asarray([r for r, _ in self._clear_bits], dtype=np.int32)
+            cols = np.asarray([c for _, c in self._clear_bits], dtype=np.int32)
+            s = s._replace(key_inc=s.key_inc.at[rows, cols].set(0))
+            self._clear_bits.clear()
+        if self._deactivate:
+            d = jnp.asarray(np.asarray(self._deactivate, dtype=np.int32))
+            s = s._replace(active=s.active.at[d].set(False),
+                           key_inc=s.key_inc.at[d].set(0),
+                           status=s.status.at[d].set(0))
+            self._deactivate.clear()
+        if self._dirty_txns:
+            dirty = sorted(self._dirty_txns)   # deterministic flush order
+            n = len(dirty)
+            slots = np.empty((n,), dtype=np.int32)
+            key_inc = np.zeros((n, self._k), dtype=np.int8)
+            ts = np.empty((n, gs.TS_LANES), dtype=np.int32)
+            txn_id = np.empty((n, gs.TS_LANES), dtype=np.int32)
+            kind = np.empty((n,), dtype=np.int8)
+            status = np.empty((n,), dtype=np.int8)
+            for i, tid in enumerate(dirty):
+                m = self.txns[tid]
+                slots[i] = m.slot
+                key_inc[i, [self.key_slot[rk] for rk in m.keys]] = 1
+                ts[i] = m.execute_at.pack_lanes()
+                txn_id[i] = tid.pack_lanes()
+                kind[i] = m.kind_code
+                status[i] = m.status
+            js = jnp.asarray(slots)
+            s = gs.GraphState(
+                key_inc=s.key_inc.at[js].set(jnp.asarray(key_inc)),
+                ts=s.ts.at[js].set(jnp.asarray(ts)),
+                txn_id=s.txn_id.at[js].set(jnp.asarray(txn_id)),
+                kind=s.kind.at[js].set(jnp.asarray(kind)),
+                status=s.status.at[js].set(jnp.asarray(status)),
+                adj=s.adj,
+                active=s.active.at[js].set(True))
+            self._dirty_txns.clear()
+        self._state = s
+
+    # -- introspection (tests / bench) ---------------------------------------
+    def device_state(self):
+        self._flush()
+        return self._state
+
+    def indexed_count(self) -> int:
+        return len(self.txns)

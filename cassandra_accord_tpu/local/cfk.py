@@ -104,16 +104,26 @@ class CommandsForKey:
 
     # -- registration -------------------------------------------------------
     def update(self, txn_id: TxnId, status: InternalStatus,
-               execute_at: Optional[Timestamp] = None) -> None:
+               execute_at: Optional[Timestamp] = None) -> bool:
         """Witness / upgrade a txn on this key. Monotonic: status never regresses,
         and execute_at only moves on a status upgrade or while ACCEPTED (the one
         phase where a re-proposal may legitimately change it; ballot gating happens
-        upstream in Commands before cfk is told)."""
+        upstream in Commands before cfk is told).
+
+        Returns False when the txn is NOT indexed: unmanaged, or at-or-below
+        ``prune_before`` and absent — a pruned (applied/invalidated) entry that a
+        late message must not resurrect (the pruning protocol's reload guard,
+        cfk/CommandsForKey.java:115-143: ids below the prune point are
+        implied-applied and served by the RedundantBefore floor deps)."""
         if not manages(txn_id):
-            return
+            return False
         probe = TxnInfo(txn_id, status, execute_at)
         i = bisect_left(self.by_id, probe)
-        if i < len(self.by_id) and self.by_id[i].txn_id == txn_id:
+        found = i < len(self.by_id) and self.by_id[i].txn_id == txn_id
+        if not found and self.prune_before is not None \
+                and txn_id <= self.prune_before:
+            return False
+        if found:
             info = self.by_id[i]
             if status > info.status:
                 info.status = status
@@ -128,6 +138,7 @@ class CommandsForKey:
             ea = execute_at if execute_at is not None else txn_id
             if self._max_applied_write is None or ea > self._max_applied_write:
                 self._max_applied_write = ea
+        return True
 
     def witness_transitively(self, txn_id: TxnId) -> None:
         if self.get(txn_id) is None:
@@ -214,17 +225,18 @@ class CommandsForKey:
         return True
 
     # -- pruning (doc CommandsForKey.java:115-143) ---------------------------
-    def _prune(self, prunable: Callable[["TxnInfo"], bool]) -> int:
+    def _prune(self, prunable: Callable[["TxnInfo"], bool]) -> List[TxnId]:
         """Drop APPLIED/INVALIDATED entries matching ``prunable``; prune_before
         is retained so late-arriving deps below it are treated as
-        already-applied rather than unknown."""
+        already-applied rather than unknown.  Returns the pruned ids (the
+        resolver data plane evicts the same incidences)."""
         keep: List[TxnInfo] = []
-        pruned = 0
+        pruned: List[TxnId] = []
         highest: Optional[TxnId] = self.prune_before
         for info in self.by_id:
             if info.status in (InternalStatus.APPLIED, InternalStatus.INVALIDATED) \
                     and prunable(info):
-                pruned += 1
+                pruned.append(info.txn_id)
                 if highest is None or info.txn_id > highest:
                     highest = info.txn_id
             else:
@@ -234,14 +246,14 @@ class CommandsForKey:
             self.prune_before = highest
         return pruned
 
-    def maybe_prune(self, prune_before_hlc_delta: int) -> int:
+    def maybe_prune(self, prune_before_hlc_delta: int) -> List[TxnId]:
         """HLC-delta policy prune: drop applied entries well behind the max HLC."""
         if not self.by_id:
-            return 0
+            return []
         cutoff_hlc = self.max_hlc() - prune_before_hlc_delta
         return self._prune(lambda info: info.txn_id.hlc < cutoff_hlc)
 
-    def prune_applied_before(self, bound: TxnId) -> int:
+    def prune_applied_before(self, bound: TxnId) -> List[TxnId]:
         """Bound-driven prune (GC by RedundantBefore): drop applied entries with
         txn_id < bound; they are implied-applied for late arrivals."""
         return self._prune(lambda info: info.txn_id < bound)

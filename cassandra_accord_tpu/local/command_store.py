@@ -76,6 +76,10 @@ class CommandStore:
         self.pending_bootstrap: Ranges = Ranges.EMPTY
         # optional persistence hook (harness Journal; simulated durability)
         self.journal = None
+        # the conflict-index data plane (impl/resolver.py): answers the deps
+        # and max-conflict queries; cpu = cfk walk, tpu = device GraphState
+        from ..impl.resolver import make_resolver
+        self.resolver = make_resolver(getattr(node, "resolver_kind", "cpu"), self)
 
     # -- ranges -------------------------------------------------------------
     def update_ranges(self, epoch: int, ranges: Ranges) -> None:
@@ -161,28 +165,31 @@ class SafeCommandStore:
         return self.store.cfks.get(key)
 
     # -- deps queries (SafeCommandStore.mapReduceActive, :292) ---------------
-    def map_reduce_active(self, keys, ranges, before: Timestamp,
-                          witnesses: Callable[[TxnId], bool],
+    def map_reduce_active(self, keys, ranges, before: Timestamp, by: TxnId,
                           visit: Callable[[object, TxnId], None]) -> None:
         """Visit (key_or_range, dep_txn_id) for every active txn with txnId < before
-        that conflicts with the given keys/ranges and is witnessed by the caller.
+        that conflicts with the given keys/ranges and is witnessed by ``by``'s kind.
 
-        - key footprint: consult each key's CommandsForKey;
+        - key footprint: the resolver's per-key conflict index (cfk walk on CPU,
+          one batched device join on TPU — impl/resolver.py);
         - plus range txns whose ranges intersect the keys;
-        - range footprint: all cfk txns on keys within the ranges + intersecting
-          range txns (InMemoryCommandStore range scan fallback :814-900).
+        - range footprint: resolver query over indexed keys within the ranges +
+          intersecting range txns (InMemoryCommandStore range scan :814-900).
         """
         local = self.store.current_ranges()
         rb = self.store.redundant_before
+        resolver = self.store.resolver
+        witnesses = by.witnesses
         if keys is not None:
+            by_rk = {}
             for key in keys:
                 rk = key.to_routing() if hasattr(key, "to_routing") else key
-                if not local.contains(rk):
-                    continue
+                if local.contains(rk):
+                    by_rk[rk] = key
+            for rk, dep in resolver.key_conflicts(by, list(by_rk), before):
+                visit(by_rk[rk], dep)
+            for rk, key in by_rk.items():
                 fence = rb.fence_before(rk)
-                cfk = self.cfk_if_exists(rk)
-                if cfk is not None:
-                    cfk.map_reduce_active(before, witnesses, lambda t, _k=key: visit(_k, t))
                 for tid, (rngs, status) in self.store.range_txns.items():
                     if tid < before and status is not InternalStatus.INVALIDATED \
                             and (fence is None or not tid < fence) \
@@ -193,9 +200,9 @@ class SafeCommandStore:
                 # elide only below the MIN fence over the whole range (a txn may
                 # intersect a sub-interval with a lower fence)
                 fence = rb.min_fence_over(rng)
-                for rk, cfk in self.store.cfks.items():
-                    if rng.contains(rk) and local.contains(rk):
-                        cfk.map_reduce_active(before, witnesses, lambda t, _rk=rk: visit(_rk, t))
+                for rk, dep in resolver.range_conflicts(by, rng, before):
+                    if local.contains(rk):
+                        visit(rk, dep)
                 for tid, (rngs, status) in self.store.range_txns.items():
                     if tid < before and status is not InternalStatus.INVALIDATED \
                             and (fence is None or not tid < fence) \
@@ -205,6 +212,7 @@ class SafeCommandStore:
     def max_conflict(self, keys, ranges) -> Optional[Timestamp]:
         """Max txnId/executeAt witnessed intersecting the footprint (MaxConflicts)."""
         out: Optional[Timestamp] = None
+        resolver = self.store.resolver
 
         def bump(ts: Optional[Timestamp]):
             nonlocal out
@@ -212,18 +220,14 @@ class SafeCommandStore:
                 out = ts
 
         if keys is not None:
-            for key in keys:
-                rk = key.to_routing() if hasattr(key, "to_routing") else key
-                cfk = self.cfk_if_exists(rk)
-                if cfk is not None:
-                    bump(cfk.max_timestamp())
+            rks = [key.to_routing() if hasattr(key, "to_routing") else key
+                   for key in keys]
+            bump(resolver.max_conflict_keys(rks))
             # range txns covering these keys (per-range MaxConflicts map)
             bump(self.store.max_conflicts.get(keys))
         if ranges is not None:
             for rng in ranges:
-                for rk, cfk in self.store.cfks.items():
-                    if rng.contains(rk):
-                        bump(cfk.max_timestamp())
+                bump(resolver.max_conflict_range(rng))
             bump(self.store.max_conflicts.get(ranges))
         return out
 
@@ -244,9 +248,15 @@ class SafeCommandStore:
             self.store.max_conflicts = self.store.max_conflicts.update(rngs, ts)
         else:
             ea = command.execute_at
-            for rk in scope:
-                if local.contains(rk):
-                    self.cfk(rk).update(command.txn_id, status, ea)
+            # feed the resolver exactly the keys the cfk indexed (it refuses
+            # unmanaged txns and pruned-entry resurrection) so both data
+            # planes stay in lockstep
+            indexed = tuple(
+                rk for rk in scope
+                if local.contains(rk)
+                and self.cfk(rk).update(command.txn_id, status, ea))
+            if indexed:
+                self.store.resolver.register(command.txn_id, status, ea, indexed)
 
     def journal_save(self, command: Command) -> None:
         """Record the command's durable state in the attached journal (no-op
@@ -309,7 +319,7 @@ class SafeCommandStore:
         for rk, cfk in store.cfks.items():
             fence = rb.fence_before(rk)
             if fence is not None:
-                cfk.prune_applied_before(fence)
+                store.resolver.on_pruned(rk, cfk.prune_applied_before(fence))
 
     def mark_shard_durable(self, txn_id: TxnId, ranges: Ranges) -> None:
         """SetShardDurable: the durability round proved (via an all-replica
@@ -362,7 +372,7 @@ class SafeCommandStore:
         for rk, cfk in store.cfks.items():
             bound = store.redundant_before.shard_redundant_before(rk)
             if bound is not None:
-                cfk.prune_applied_before(bound)
+                store.resolver.on_pruned(rk, cfk.prune_applied_before(bound))
         for txn_id in list(store.range_txns):
             rngs, _status = store.range_txns[txn_id]
             if store.redundant_before.is_locally_redundant(txn_id, rngs) \

@@ -34,7 +34,8 @@ class Node(ConfigurationService.Listener):
                  random: RandomSource, now_micros: Callable[[], int],
                  num_shards: int = 1,
                  executor_factory: Optional[Callable[[int], AgentExecutor]] = None,
-                 progress_log_factory: Optional[Callable[[object], ProgressLog]] = None):
+                 progress_log_factory: Optional[Callable[[object], ProgressLog]] = None,
+                 resolver: Optional[str] = None):
         self.id = node_id
         self.message_sink = message_sink
         self.config_service = config_service
@@ -43,6 +44,10 @@ class Node(ConfigurationService.Listener):
         self.data_store = data_store
         self.random = random
         self._now_micros = now_micros
+        # deps-resolver data plane selection (impl/resolver.py): cpu|tpu|verify
+        from ..impl.resolver import resolver_kind_from_env
+        self.resolver_kind = resolver if resolver is not None \
+            else resolver_kind_from_env()
         self.topology = TopologyManager(node_id)
         self.command_stores = CommandStores(self, num_shards, executor_factory)
         self._progress_log_factory = progress_log_factory

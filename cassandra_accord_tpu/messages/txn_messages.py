@@ -51,7 +51,7 @@ def calculate_partial_deps(safe_store: SafeCommandStore, txn_id: TxnId,
         if dep_id != txn_id:
             builder.add(key_or_range, dep_id)
 
-    safe_store.map_reduce_active(keys, ranges, before, txn_id.witnesses, visit)
+    safe_store.map_reduce_active(keys, ranges, before, txn_id, visit)
     # floor deps: the fence txns standing in for everything elided below them
     # (RedundantBefore.collectDeps, PreAccept.java:264)
     safe_store.redundant_before().collect_deps(keys, ranges, visit)

@@ -78,18 +78,20 @@ def overlap_join(index_key_inc: jax.Array,   # [T, K] int8 — in-flight txns
                  index_status: jax.Array,    # [T] int8
                  index_active: jax.Array,    # [T] bool
                  batch_key_inc: jax.Array,   # [B, K] int8 — new txns' keys
-                 batch_txn_id: jax.Array,    # [B, 5] int32
+                 batch_before: jax.Array,    # [B, 5] int32 — started-before bound
                  batch_kind: jax.Array,      # [B] int8
                  ) -> jax.Array:
     """For each of B new transactions, the set of in-flight txns it must
     depend on: shares >=1 key, witness-matrix hit, active, not invalidated,
-    and STARTED BEFORE in TxnId order (mapReduceActive's
-    TestStartedAt.STARTED_BEFORE, SafeCommandStore.java:65-72).
+    and STARTED BEFORE the query bound (mapReduceActive's
+    TestStartedAt.STARTED_BEFORE, SafeCommandStore.java:65-72).  The bound is
+    the txnId for PreAccept and the proposed executeAt for the Accept round's
+    deps-at-executeAt (Accept.java:84-118).
 
     Returns deps: [B, T] bool."""
     share_key = _bool_matmul(batch_key_inc, index_key_inc.T)             # [B, T]
     started_before = ts_less(index_txn_id[None, :, :],
-                             batch_txn_id[:, None, :])                   # [B, T]
+                             batch_before[:, None, :])                   # [B, T]
     witnesses = WITNESSES[batch_kind[:, None].astype(jnp.int32),
                           index_kind[None, :].astype(jnp.int32)]         # [B, T]
     eligible = index_active & (index_status != INVALIDATED)              # [T]
@@ -130,6 +132,27 @@ def max_conflict_ts(index_exec_at: jax.Array,  # [T, 5] int32
         jnp.broadcast_to(index_exec_at[None, :, :],
                          deps.shape + (index_exec_at.shape[-1],)), deps)
     return conflict_max, jnp.any(deps, axis=1)
+
+
+@jax.jit
+def max_conflict_keys(index_key_inc: jax.Array,  # [T, K] int8
+                      index_ts: jax.Array,       # [T, 5] int32 executeAt
+                      index_txn_id: jax.Array,   # [T, 5] int32
+                      index_active: jax.Array,   # [T] bool
+                      batch_key_inc: jax.Array,  # [B, K] int8
+                      ) -> jax.Array:
+    """Per query, the lexicographic max of max(executeAt, txnId) over every
+    active indexed txn sharing a key — the per-key half of the MaxConflicts
+    consult in the replica timestamp proposal (cfk.max_timestamp per key,
+    Commands.preaccept; MaxConflicts.java:32).  Returns [B, 5] int32 (zero
+    lanes = none)."""
+    share_key = _bool_matmul(batch_key_inc, index_key_inc.T)   # [B, T]
+    mask = share_key & index_active[None, :]
+    per_slot = jnp.where(ts_less(index_ts, index_txn_id)[:, None],
+                         index_txn_id, index_ts)               # [T, 5]
+    return _lex_max_masked(
+        jnp.broadcast_to(per_slot[None, :, :], mask.shape + (per_slot.shape[-1],)),
+        mask)
 
 
 # ---------------------------------------------------------------------------

@@ -4,6 +4,7 @@ Parity targets: accord-maelstrom Json.java (full wire codec), Main.java serve lo
 maelstrom/Cluster.java (random delays + partitions), Runner/SimpleRandomTest.
 """
 import json
+import os
 import subprocess
 import sys
 
@@ -155,10 +156,11 @@ def test_stdio_single_node():
          "body": {"type": "txn", "msg_id": 3,
                   "txn": [["append", 5, 2], ["r", 5, None]]}},
     ]
+    env = dict(os.environ, ACCORD_RESOLVER="cpu")  # no jax cold-start in subprocess
     proc = subprocess.run(
         [sys.executable, "-m", "cassandra_accord_tpu.maelstrom"],
         input="\n".join(json.dumps(l) for l in lines) + "\n",
-        capture_output=True, text=True, timeout=60)
+        capture_output=True, text=True, timeout=60, env=env)
     replies = [json.loads(l) for l in proc.stdout.splitlines()
                if '"dest":"c1"' in l or '"dest": "c1"' in l]
     by_reply = {r["body"].get("in_reply_to"): r["body"] for r in replies}

@@ -1,0 +1,205 @@
+"""DepsResolver boundary: CPU/TPU parity, slot lifecycle, burn-level parity.
+
+Parity targets: SafeCommandStore.mapReduceActive (SafeCommandStore.java:292),
+cfk/CommandsForKey.java:925-1000 (the hot deps query), MaxConflicts.java:32.
+The TPU resolver (impl/tpu_resolver.py) must answer every query bit-identically
+to the CPU reference walk — VerifyDepsResolver asserts this on every call.
+"""
+import pytest
+
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.cluster import Cluster
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.impl.resolver import (CpuDepsResolver,
+                                                VerifyDepsResolver)
+from cassandra_accord_tpu.impl.tpu_resolver import TpuDepsResolver
+from cassandra_accord_tpu.local.cfk import InternalStatus
+from cassandra_accord_tpu.primitives.keys import IntKey, Range, Ranges
+from cassandra_accord_tpu.primitives.timestamp import (Domain, Timestamp, TxnId,
+                                                       TxnKind)
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v):
+    return IntKey(v)
+
+
+def rk(v):
+    return IntKey(v).to_routing()
+
+
+def tid(hlc, node=1, kind=TxnKind.WRITE):
+    return TxnId(epoch=1, hlc=hlc, node=node, kind=kind, domain=Domain.KEY)
+
+
+class _FakeStore:
+    """Minimal stand-in exposing .cfks for the CPU resolver."""
+
+    def __init__(self):
+        self.cfks = {}
+
+    def cfk(self, key):
+        from cassandra_accord_tpu.local.cfk import CommandsForKey
+        c = self.cfks.get(key)
+        if c is None:
+            c = CommandsForKey(key)
+            self.cfks[key] = c
+        return c
+
+
+def make_pair():
+    store = _FakeStore()
+    cpu = CpuDepsResolver(store)
+    tpu = TpuDepsResolver(store, txn_capacity=4, key_capacity=4)  # force growth
+    return store, VerifyDepsResolver(cpu, tpu)
+
+
+def register_both(store, verify, txn_id, status, execute_at, keys):
+    """Mirror SafeCommandStore.register_witness: cfk update + resolver feed
+    (only keys the cfk actually indexed — its prune guard may refuse)."""
+    indexed = tuple(key for key in keys
+                    if store.cfk(key).update(txn_id, status, execute_at))
+    if indexed:
+        verify.register(txn_id, status, execute_at, indexed)
+
+
+def test_parity_random_workload():
+    """10k randomized register/update/prune/query ops: every query must agree
+    bit-for-bit between the cfk walk and the device join."""
+    rng = RandomSource(1234)
+    store, verify = make_pair()
+    keys = [rk(i * 10) for i in range(12)]
+    live = []
+    hlc = 0
+    for _ in range(600):
+        roll = rng.next_float()
+        if roll < 0.35 or not live:
+            hlc += rng.next_int(1, 5)
+            kind = rng.pick([TxnKind.WRITE, TxnKind.READ, TxnKind.WRITE])
+            t = tid(hlc, node=1 + rng.next_int(3), kind=kind)
+            ks = sorted({rng.pick(keys) for _ in range(rng.next_int(1, 4))})
+            register_both(store, verify, t, InternalStatus.PREACCEPTED, None, ks)
+            live.append((t, ks))
+        elif roll < 0.55:
+            t, ks = rng.pick(live)
+            status = rng.pick([InternalStatus.ACCEPTED, InternalStatus.COMMITTED,
+                               InternalStatus.STABLE, InternalStatus.APPLIED,
+                               InternalStatus.INVALIDATED])
+            ea = Timestamp(1, hlc + rng.next_int(10), 0, t.node) \
+                if status in (InternalStatus.ACCEPTED, InternalStatus.COMMITTED,
+                              InternalStatus.STABLE) else None
+            register_both(store, verify, t, status, ea, ks)
+        elif roll < 0.65:
+            # bound-prune one key (GC): both planes must evict identically
+            key = rng.pick(keys)
+            cfk = store.cfks.get(key)
+            if cfk is not None:
+                bound = tid(hlc + 1)
+                verify.on_pruned(key, cfk.prune_applied_before(bound))
+        else:
+            hlc += 1
+            q = tid(hlc, kind=rng.pick([TxnKind.WRITE, TxnKind.READ]))
+            qk = sorted({rng.pick(keys) for _ in range(rng.next_int(1, 5))})
+            before = q.as_timestamp() if rng.next_boolean() else Timestamp.MAX
+            verify.key_conflicts(q, qk, before)
+            verify.max_conflict_keys(qk)
+            if rng.next_boolean():
+                rng_lo = rng.next_int(0, 100)
+                r = Range(k(rng_lo), k(rng_lo + rng.next_int(10, 60)))
+                verify.range_conflicts(q, r, before)
+                verify.max_conflict_range(r)
+    assert verify.queries > 100
+
+
+def test_slot_recycling_and_growth():
+    """Slots free when a txn is pruned from all keys; capacity growth rebuilds
+    losslessly (start capacity 4, insert dozens)."""
+    store, verify = make_pair()
+    tpu = verify.tpu
+    all_ids = []
+    for i in range(40):
+        t = tid(10 + i)
+        register_both(store, verify, t, InternalStatus.PREACCEPTED, None,
+                      [rk(i % 6 * 10)])
+        all_ids.append(t)
+    assert tpu.indexed_count() == 40
+    # apply + prune the first 30 from their keys
+    for i, t in enumerate(all_ids[:30]):
+        register_both(store, verify, t, InternalStatus.APPLIED, None,
+                      [rk(i % 6 * 10)])
+    for key in list(store.cfks):
+        verify.on_pruned(key, store.cfks[key].prune_applied_before(tid(40)))
+    assert tpu.indexed_count() == 10
+    # queries over the survivors still agree
+    q = tid(1000)
+    got = verify.key_conflicts(q, [rk(i * 10) for i in range(6)],
+                               q.as_timestamp())
+    assert {t for _, t in got} == set(all_ids[30:])
+    # recycled slots are reused
+    for i in range(20):
+        register_both(store, verify, tid(2000 + i), InternalStatus.PREACCEPTED,
+                      None, [rk(0)])
+    verify.key_conflicts(tid(3000), [rk(0)], tid(3000).as_timestamp())
+
+
+def test_multi_key_partial_prune():
+    """A txn pruned from one key must stay visible via its other keys."""
+    store, verify = make_pair()
+    t = tid(10)
+    register_both(store, verify, t, InternalStatus.APPLIED, None,
+                  [rk(0), rk(10)])
+    verify.on_pruned(rk(0), store.cfks[rk(0)].prune_applied_before(tid(50)))
+    q = tid(100)
+    got = verify.key_conflicts(q, [rk(0), rk(10)], q.as_timestamp())
+    assert got == [(rk(10), t)]
+    assert verify.tpu.indexed_count() == 1
+    # now prune the second key: slot recycles
+    verify.on_pruned(rk(10), store.cfks[rk(10)].prune_applied_before(tid(50)))
+    assert verify.key_conflicts(q, [rk(0), rk(10)], q.as_timestamp()) == []
+    assert verify.tpu.indexed_count() == 0
+
+
+def test_witness_matrix_parity():
+    """Reads witness writes but not reads; writes witness both (Txn.java:221-262)."""
+    store, verify = make_pair()
+    w = tid(10, kind=TxnKind.WRITE)
+    r = tid(20, kind=TxnKind.READ)
+    register_both(store, verify, w, InternalStatus.PREACCEPTED, None, [rk(0)])
+    register_both(store, verify, r, InternalStatus.PREACCEPTED, None, [rk(0)])
+    read_q = tid(30, kind=TxnKind.READ)
+    write_q = tid(30, kind=TxnKind.WRITE)
+    got_r = verify.key_conflicts(read_q, [rk(0)], read_q.as_timestamp())
+    got_w = verify.key_conflicts(write_q, [rk(0)], write_q.as_timestamp())
+    assert {t for _, t in got_r} == {w}
+    assert {t for _, t in got_w} == {w, r}
+
+
+def test_cluster_end_to_end_verify_resolver():
+    """A full simulated-cluster run with the parity-asserting resolver."""
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    cluster = Cluster(Topology(1, shards), seed=77, resolver="verify")
+    results = []
+    for i in range(12):
+        txn = list_txn([k(5)] if i % 4 == 0 else [],
+                       {k(5): f"v{i}", k(600): f"w{i}"})
+        results.append(cluster.nodes[1 + i % 3].coordinate(txn))
+    assert cluster.run_until(lambda: all(r.is_done() for r in results))
+    cluster.run_until_idle()
+    assert all(r.failure is None for r in results)
+    lists = {cluster.stores[n].get(k(5)) for n in cluster.nodes}
+    assert len(lists) == 1
+    # parity checks actually ran
+    total = 0
+    for n in cluster.nodes:
+        for store in cluster.nodes[n].command_stores.all_stores():
+            assert isinstance(store.resolver, VerifyDepsResolver)
+            total += store.resolver.queries
+    assert total > 50, f"only {total} parity-checked queries"
+
+
+def test_burn_with_verify_resolver():
+    """Seeded burn (topology churn + journal) under continuous deps parity."""
+    result = run_burn(seed=424242, ops=80, concurrency=8, topology_churn=True,
+                      journal=True, resolver="verify")
+    assert result.ops_ok > 0
