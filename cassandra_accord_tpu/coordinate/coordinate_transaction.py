@@ -254,8 +254,17 @@ class _ExecuteTxn:
         self.data = None
         self.done = False
 
+    @property
+    def needs_read(self) -> bool:
+        """Sync points (and any read-less txn) have no data to collect: their
+        execution phase is a pure dependency wait at the replicas, so no read
+        is fused with Stable (ExecuteSyncPoint vs ExecuteTxn,
+        CoordinationAdapter.java:214-264)."""
+        return self.txn.read is not None and not self.txn_id.kind.is_sync_point
+
     def start(self) -> None:
-        read_nodes = set(self.read_tracker.initial_contacts(prefer=self.node.id))
+        read_nodes = set(self.read_tracker.initial_contacts(prefer=self.node.id)) \
+            if self.needs_read else set()
         this = self
 
         class ExecuteCallback(Callback):
@@ -270,8 +279,9 @@ class _ExecuteTxn:
                             is RequestStatus.SUCCESS:
                         this.maybe_finish()
                 elif isinstance(reply, ReadNack):
-                    if reply.reason == "unavailable":
-                        # replica is bootstrapping these ranges: read elsewhere
+                    if reply.reason in ("unavailable", "obsolete"):
+                        # bootstrapping replica, or one that raced past
+                        # ReadyToExecute (an Apply won): read elsewhere
                         # (the Stable part already acked separately)
                         status, retries = this.read_tracker.record_read_failure(from_node)
                         if status is RequestStatus.FAILED:
@@ -301,6 +311,8 @@ class _ExecuteTxn:
                 if this.stable_tracker.record_failure(from_node) is RequestStatus.FAILED:
                     this.done = True
                     this.result.set_failure(Exhausted(this.txn_id, "stabilise"))
+                    return
+                if not this.needs_read:
                     return
                 status, retries = this.read_tracker.record_read_failure(from_node)
                 if status is RequestStatus.FAILED:
@@ -338,7 +350,8 @@ class _ExecuteTxn:
     def maybe_finish(self) -> None:
         if self.done:
             return
-        reads_done = self.read_tracker._all_success(lambda t: t.data_received)
+        reads_done = not self.needs_read \
+            or self.read_tracker._all_success(lambda t: t.data_received)
         stable_done = (not self.require_stable_quorum
                        or self.stable_tracker.has_reached_quorum())
         if reads_done and stable_done:
@@ -422,12 +435,25 @@ class _ExecuteTxn:
 # found, carrying its ballot through every subsequent round.
 # ---------------------------------------------------------------------------
 
+def _resume_coordinator(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
+                        result: au.Settable) -> "_CoordinateTransaction":
+    """Recovery resume must drive sync points through the sync-point adapter:
+    their execution phase is a pure dependency wait with MAXIMAL applies and NO
+    read round — resuming one through the txn adapter sends reads that replicas
+    past ReadyToExecute nack as obsolete, exhausting every recovery attempt
+    (CoordinationAdapter recovery adapters, CoordinationAdapter.java:214-264)."""
+    if txn_id.kind.is_sync_point:
+        from .sync_point import _CoordinateSyncPoint
+        return _CoordinateSyncPoint(node, txn_id, txn, route, result, blocking=True)
+    return _CoordinateTransaction(node, txn_id, txn, route, result)
+
+
 def resume_propose(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
                    result: au.Settable, ballot: Ballot, execute_at: Timestamp,
                    deps: Deps) -> None:
     """Re-run the Accept round at ``ballot`` (recovery of an Accepted txn, or
     re-proposal at txnId when the fast path may have succeeded)."""
-    c = _CoordinateTransaction(node, txn_id, txn, route, result)
+    c = _resume_coordinator(node, txn_id, txn, route, result)
     c.extend_to_epoch(execute_at, lambda: c.propose(ballot, execute_at, deps))
 
 
@@ -435,7 +461,7 @@ def resume_stabilise(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
                      result: au.Settable, ballot: Ballot, execute_at: Timestamp,
                      deps: Deps) -> None:
     """Re-run Stable+Execute (recovery of a Committed/Stable txn)."""
-    c = _CoordinateTransaction(node, txn_id, txn, route, result)
+    c = _resume_coordinator(node, txn_id, txn, route, result)
     c.extend_to_epoch(execute_at,
                       lambda: c.stabilise_and_execute(execute_at, deps, ballot))
 

@@ -18,7 +18,7 @@ from ..messages.status_messages import CheckStatusOk, propagate_knowledge
 from ..primitives.route import Route
 from ..primitives.timestamp import Ballot, TxnId
 from ..utils import async_ as au
-from .errors import Invalidated
+from .errors import Invalidated, Truncated
 from .fetch_data import check_status_quorum
 from .recover import invalidate as do_invalidate, recover as do_recover
 
@@ -82,9 +82,13 @@ def maybe_recover(node: "Node", txn_id: TxnId, route: Route,
             return
 
         # stalled: escalate (RecoverWithRoute)
-        full_route = merged.route if merged.route is not None and merged.route.full \
-            else route
         txn = merged.full_txn()
+        if merged.route is not None and merged.route.full:
+            full_route = merged.route
+        elif txn is not None:
+            full_route = node.compute_route(txn)   # real footprint, not the hint
+        else:
+            full_route = route
         rec = au.settable()
         if txn is not None:
             do_recover(node, txn_id, txn, full_route, rec)
@@ -93,7 +97,9 @@ def maybe_recover(node: "Node", txn_id: TxnId, route: Route,
             do_invalidate(node, txn_id, full_route, rec)
 
         def on_recovered(_value, rec_failure):
-            if rec_failure is None or isinstance(rec_failure, Invalidated):
+            if rec_failure is None or isinstance(rec_failure, (Invalidated, Truncated)):
+                # recovered, durably invalidated, or already truncated (decided
+                # and cleaned up): the txn is settled either way
                 result.set_success(Outcome(
                     ProgressToken(token.durability, SaveStatus.APPLIED.ordinal,
                                   token.promised), settled=True))

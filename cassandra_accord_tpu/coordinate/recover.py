@@ -128,11 +128,7 @@ class _Recover:
                 self.commit_invalidate()
                 return
             if status.has_been(Status.PRE_APPLIED):
-                # outcome known: make it durable everywhere, report it
-                persist_maximal(self.node, self.txn_id, self.txn, self.route,
-                                self.topologies, execute_at, merged_deps,
-                                best.writes, best.result)
-                self.succeed(best.result)
+                self.persist_known_outcome(execute_at, merged_deps)
                 return
             if status.has_been(Status.STABLE) or status.has_been(Status.PRE_COMMITTED):
                 # executeAt decided: (re-)stabilise at it, then execute.
@@ -173,6 +169,43 @@ class _Recover:
         resume_propose(self.node, self.txn_id, self.txn, self.route, self.result,
                        self.ballot, self.txn_id.as_timestamp(), merged_deps)
         self._on_settled()
+
+    def persist_known_outcome(self, execute_at: Timestamp, merged_deps: Deps) -> None:
+        """Some replica applied the txn: assemble the COMPLETE outcome before
+        re-disseminating it.  A single RecoverOk's writes are that replica's
+        per-shard SLICE — persisting a slice as if it were the whole write-set
+        silently drops the other shards' writes at every replica that adopts it
+        (the divergence class the hostile burn caught).  Fetch the outcome over
+        the full route (slice-union + applied_for coverage check,
+        CheckStatusOk.merge); if the union does not yet cover the footprint,
+        fall back to re-stabilise/execute at the known executeAt."""
+        this = self
+        self.done = True
+        from .fetch_data import fetch_data
+
+        def on_fetched(merged, failure):
+            if failure is not None:
+                this.result.set_failure(failure)   # progress log retries
+                return
+            parts = this.route.participants()
+            if merged is not None and merged.writes is not None \
+                    and merged.execute_at is not None \
+                    and merged.applied_for.contains_all(parts):
+                deps = merged.partial_deps \
+                    if merged.partial_deps is not None \
+                    and merged.stable_for.contains_all(parts) else merged_deps
+                persist_maximal(this.node, this.txn_id, this.txn, this.route,
+                                this.topologies, merged.execute_at, deps,
+                                merged.writes, merged.result)
+                this.node.agent.metrics_events_listener().on_recover(
+                    this.txn_id, this.ballot)
+                this.result.set_success(merged.result)
+            else:
+                resume_stabilise(this.node, this.txn_id, this.txn, this.route,
+                                 this.result, this.ballot, execute_at, merged_deps)
+                this._on_settled()
+
+        fetch_data(self.node, self.txn_id, self.route).add_listener(on_fetched)
 
     # -- await earlier uncommitted no-witness txns ----------------------------
     def await_commits(self, waiting_on: Deps) -> None:
@@ -223,6 +256,13 @@ class _Recover:
                                             f"invalidate superseded by {reply.superseded_by}"))
                     return
                 if reply.status.has_been(Status.PRE_COMMITTED):
+                    this.retry()
+                    return
+                if reply.status.has_been(Status.ACCEPTED):
+                    # a real Accept vote at some ballot: the txn may have been
+                    # committed by that proposer — re-run recovery to adopt it
+                    # (the Paxos value-adoption rule; invalidating would race a
+                    # completed commit)
                     this.retry()
                     return
                 if tracker.record_success(from_node) is RequestStatus.SUCCESS:
@@ -287,7 +327,8 @@ def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,
     shard = topology.for_key_required(route.home_key)
     tracker = QuorumTracker(node.topology.precise_epochs(
         route.home_key_only(), txn_id.epoch, txn_id.epoch))
-    state = {"done": False, "learned_route": None, "has_definition": False}
+    state = {"done": False, "learned_route": None, "has_definition": False,
+             "has_accept": False}
 
     def finish(failure: BaseException) -> None:
         if not state["done"]:
@@ -307,25 +348,48 @@ def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,
         """SAFETY (Invalidate.java): our home-shard quorum intersects any fast-path
         quorum, so if a contacted replica knows the definition the txn may have
         fast-committed — recover it instead of invalidating.  Fetch the definition
-        cluster-wide, reconstitute, and run full recovery."""
+        cluster-wide, reconstitute, and run full recovery.
+
+        LIVENESS (Invalidate.java:125-135, 170-195): when even a quorum read of
+        EVERY shard of the full route cannot reassemble the definition and no
+        shard shows Accepted+, the fast path provably never committed (a fast
+        quorum per shard must hold that shard's definition slice, and every
+        majority read intersects every fast quorum), and our home-shard promises
+        block any future fast-path decision — so invalidation is safe.  Without
+        this rule, a PreAccept that reached only a minority of some shard makes
+        invalidate<->recover ping-pong forever."""
         state["done"] = True
         from .fetch_data import fetch_data
 
-        def on_fetched(merged, failure):
-            if failure is not None:
-                result.set_failure(failure)
-                return
-            txn = merged.full_txn() if merged is not None else None
-            full_route = merged.route if merged is not None and merged.route is not None \
-                else learned_route
-            if txn is None:
+        def attempt(fetch_route: Route, allow_refetch: bool) -> None:
+            def on_fetched(merged, failure):
+                if failure is not None:
+                    result.set_failure(failure)
+                    return
+                txn = merged.full_txn() if merged is not None else None
+                mroute = merged.route if merged is not None else None
+                if txn is not None:
+                    full_route = mroute if mroute is not None and mroute.full \
+                        else fetch_route
+                    recover(node, txn_id, txn, full_route, result,
+                            ballot=node.ballot_after(ballot))
+                    return
+                if allow_refetch and mroute is not None and mroute.full \
+                        and mroute != fetch_route:
+                    attempt(mroute, False)   # now query the txn's FULL footprint
+                    return
+                if mroute is not None and mroute.full and merged is not None \
+                        and not merged.save_status.has_been(Status.ACCEPTED):
+                    # quorum of every shard read; no definition, nothing Accepted+
+                    state["done"] = False    # re-arm terminal bookkeeping
+                    commit_invalidate()
+                    return
                 result.set_failure(Exhausted(
                     txn_id, "definition known but not reconstitutable yet"))
-                return
-            recover(node, txn_id, txn, full_route, result,
-                    ballot=node.ballot_after(ballot))
 
-        fetch_data(node, txn_id, learned_route).add_listener(on_fetched)
+            fetch_data(node, txn_id, fetch_route).add_listener(on_fetched)
+
+        attempt(learned_route, True)
 
     class InvalidateCallback(Callback):
         def on_success(self, from_node: int, reply) -> None:
@@ -338,13 +402,19 @@ def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,
             if reply.status.has_been(Status.PRE_COMMITTED):
                 finish(Preempted(txn_id, "txn committed concurrently"))
                 return
+            if reply.status.has_been(Status.ACCEPTED):
+                # a real Accept vote (which carries no definition): the txn may
+                # be committed — never count this toward an invalidation quorum;
+                # escalate to recovery via the definition-fetch path instead
+                # (Paxos value adoption: the highest accepted value governs)
+                state["has_accept"] = True
             if reply.has_definition or reply.route is not None:
                 state["has_definition"] = state["has_definition"] or reply.has_definition
                 if reply.route is not None:
                     state["learned_route"] = reply.route if state["learned_route"] is None \
                         else state["learned_route"]
             if tracker.record_success(from_node) is RequestStatus.SUCCESS:
-                if state["has_definition"]:
+                if state["has_definition"] or state["has_accept"]:
                     escalate(state["learned_route"] if state["learned_route"] is not None
                              else route)
                 else:

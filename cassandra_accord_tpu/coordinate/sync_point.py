@@ -17,7 +17,7 @@ dependency-wait — once applied, every transaction in its dependency set is dec
 """
 from __future__ import annotations
 
-from typing import TYPE_CHECKING
+from typing import Optional, TYPE_CHECKING
 
 from ..local.status import SaveStatus
 from ..primitives.keys import Ranges
@@ -40,16 +40,21 @@ def coordinate_inclusive(node: "Node", seekables: Seekables,
 
 
 def coordinate_exclusive(node: "Node", ranges: Ranges,
-                         blocking: bool = True) -> au.AsyncResult:
+                         blocking: bool = True,
+                         txn_id: Optional[TxnId] = None) -> au.AsyncResult:
     """Coordinate an exclusive sync point over ``ranges``
-    (CoordinateSyncPoint.exclusive; used by Bootstrap and durability rounds)."""
-    return _coordinate(node, TxnKind.EXCLUSIVE_SYNC_POINT, ranges, blocking=blocking)
+    (CoordinateSyncPoint.exclusive; used by Bootstrap and durability rounds).
+    ``txn_id`` may be pre-allocated by the caller (Bootstrap marks
+    bootstrappedAt with it BEFORE coordinating)."""
+    return _coordinate(node, TxnKind.EXCLUSIVE_SYNC_POINT, ranges,
+                       blocking=blocking, txn_id=txn_id)
 
 
 def _coordinate(node: "Node", kind: TxnKind, seekables: Seekables,
-                blocking: bool) -> au.AsyncResult:
+                blocking: bool, txn_id: Optional[TxnId] = None) -> au.AsyncResult:
     txn = Txn.empty(kind, seekables)
-    txn_id = node.next_txn_id(kind, txn.domain)
+    if txn_id is None:
+        txn_id = node.next_txn_id(kind, txn.domain)
     result = au.settable()
 
     def start(_v, f):
@@ -120,26 +125,68 @@ class _ExecuteSyncPoint(_ExecuteTxn):
         self.blocking = blocking
 
     def persist(self) -> None:
-        sync_point = SyncPoint(self.txn_id, self.route, self.deps)
+        sync_point = SyncPoint(self.txn_id, self.route, self.deps,
+                               execute_at=self.execute_at)
         txn_result = self.txn.result(self.txn_id, self.execute_at, self.data)
         writes = self.txn.execute(self.txn_id, self.execute_at, self.data)
         if not self.blocking:
             self.result.set_success(sync_point)
+            self.send_applies(writes, txn_result, Apply.MAXIMAL,
+                              on_quorum_applied=lambda: (
+                                  self.on_quorum_applied(sync_point),
+                                  self.inform_durable()))
+            return
 
-        def on_applied():
-            if self.blocking and not self.result.is_done():
-                self.result.set_success(sync_point)
-            self.on_quorum_applied(sync_point)
-            self.inform_durable()
+        # blocking: the quorum must mean EXECUTED, not merely recorded — send
+        # ApplyThenWaitUntilApplied, whose ack is deferred until the txn (and
+        # hence its whole dependency set) has applied locally
+        # (ExecuteSyncPoint.ExecuteBlocking, ExecuteSyncPoint.java)
+        from ..messages.base import Callback, TxnRequest
+        from ..messages.txn_messages import ApplyOk, ApplyThenWaitUntilApplied
+        from .tracking import QuorumTracker, RequestStatus
+        from .coordinate_transaction import _scope_ranges
+        tracker = QuorumTracker(self.topologies)
+        this = self
+        state = {"done": False}
 
-        def on_impossible():
-            if self.blocking and not self.result.is_done():
-                from .errors import Exhausted
-                self.result.set_failure(Exhausted(self.txn_id, "apply quorum"))
+        def finish_ok():
+            state["done"] = True
+            if not this.result.is_done():
+                this.result.set_success(sync_point)
+            this.on_quorum_applied(sync_point)
+            this.inform_durable()
 
-        self.send_applies(writes, txn_result, Apply.MAXIMAL,
-                          on_quorum_applied=on_applied,
-                          on_quorum_impossible=on_impossible)
+        class AppliedCallback(Callback):
+            def on_success(self, from_node: int, reply) -> None:
+                if state["done"]:
+                    return
+                if not isinstance(reply, ApplyOk):
+                    self.on_failure(from_node, RuntimeError(f"bad reply {reply!r}"))
+                    return
+                if tracker.record_success(from_node) is RequestStatus.SUCCESS:
+                    finish_ok()
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                if state["done"]:
+                    return
+                if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                    state["done"] = True
+                    from .errors import Exhausted
+                    if not this.result.is_done():
+                        this.result.set_failure(Exhausted(this.txn_id, "apply quorum"))
+
+        callback = AppliedCallback()
+        for to in self.topologies.nodes():
+            scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+            if scope is None:
+                continue
+            wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+            ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
+            self.node.send(to, ApplyThenWaitUntilApplied(
+                self.txn_id, scope, wait_for, Apply.MAXIMAL, self.execute_at,
+                self.deps.slice(ranges), self.txn.slice(ranges, include_query=False),
+                writes.slice(ranges) if writes is not None else None,
+                txn_result, route=self.route), callback)
 
     def on_quorum_applied(self, sync_point: SyncPoint) -> None:
         """Hook: exclusive sync points mark epochs closed / redundancy bounds
@@ -149,3 +196,10 @@ class _ExecuteSyncPoint(_ExecuteTxn):
             if isinstance(participants, Ranges):
                 self.node.on_exclusive_sync_point_applied(
                     self.txn_id, participants)
+                # the applied fence witnessed every in-flight txn on these
+                # ranges in the epochs below it: they are CLOSED to new
+                # coordination (CoordinationAdapter exclusive sync point
+                # epoch-closure, CoordinationAdapter.java:214-264)
+                for e in range(self.node.topology.min_epoch, self.txn_id.epoch):
+                    if self.node.topology.has_epoch(e):
+                        self.node.on_epoch_closed(participants, e)

@@ -6,12 +6,23 @@ fully determines topology (rf, node count, key count), the randomized client wor
 (read/write/read-write txns over 1-3 keys, zipf-or-uniform key choice), concurrency
 window, link latencies and faults; every client op feeds the verifier; any violation
 or unresolved op fails the run with its seed.
+
+Hostile mode (``chaos=True``) adds the reference's full fault model: per-link
+behavior (drop / failure / latency spikes) and minority partitions re-randomized
+every 5s of sim-time (impl/basic/Cluster.java:455-459), with the progress log
+driving recovery and the client resolving lost responses through home-shard
+CheckStatus probes classified Applied/Invalidated/Truncated/Lost
+(impl/list/ListRequest.java:61-150).  Every op must still resolve; the verifier
+constrains acked ops fully, requires invalidated writes to never surface, and
+leaves lost ops unconstrained.
 """
 from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from ..coordinate.errors import CoordinationFailed, Invalidated
 from ..impl.list_store import ListResult, list_txn, range_read_txn
+from ..local.status import SaveStatus, Status
 from ..primitives.keys import IntKey, Range, Ranges
 from ..topology.topology import Shard, Topology
 from ..utils.random import RandomSource
@@ -23,14 +34,24 @@ class BurnResult:
     def __init__(self, seed: int):
         self.seed = seed
         self.ops_submitted = 0
-        self.ops_ok = 0
-        self.ops_failed = 0
+        self.ops_ok = 0          # acked with result
+        self.ops_recovered = 0   # resolved Applied via client CheckStatus probe
+        self.ops_nacked = 0      # durably invalidated
+        self.ops_lost = 0        # resolved Lost/Truncated (outcome unknown)
+        self.ops_failed = 0      # unexpected failure
         self.sim_micros = 0
         self.stats: Dict[str, int] = {}
 
+    @property
+    def resolved(self) -> int:
+        return (self.ops_ok + self.ops_recovered + self.ops_nacked
+                + self.ops_lost + self.ops_failed)
+
     def __repr__(self):
         return (f"BurnResult(seed={self.seed}, ok={self.ops_ok}, "
-                f"failed={self.ops_failed}, sim_ms={self.sim_micros // 1000})")
+                f"recovered={self.ops_recovered}, nacked={self.ops_nacked}, "
+                f"lost={self.ops_lost}, failed={self.ops_failed}, "
+                f"sim_ms={self.sim_micros // 1000})")
 
 
 class SimulationException(Exception):
@@ -43,6 +64,15 @@ class SimulationException(Exception):
         self.cause = cause
 
 
+MAX_PROBE_ATTEMPTS = 1000   # ListRequest.java:204 "arbitrarily large limit"
+
+
+def last_cluster():
+    """The most recent run's Cluster while it is still alive (debug/forensics)."""
+    ref = getattr(run_burn, "last_cluster_ref", None)
+    return ref() if ref is not None else None
+
+
 def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              link_config: Optional[LinkConfig] = None,
              nodes: Optional[int] = None, rf: Optional[int] = None,
@@ -53,13 +83,27 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              delayed_stores: bool = False,
              clock_drift: bool = False,
              journal: bool = False,
-             resolver: Optional[str] = None) -> BurnResult:
-    """Run one seeded burn; raises SimulationException on any violation."""
+             resolver: Optional[str] = None,
+             chaos: bool = False,
+             chaos_interval_s: float = 5.0,
+             progress_log: Optional[bool] = None,
+             progress_poll_s: float = 0.5,
+             durability: bool = False,
+             max_tasks: int = 20_000_000,
+             tracer=None, on_submit=None) -> BurnResult:
+    """Run one seeded burn; raises SimulationException on any violation.
+
+    ``chaos=True`` turns on the hostile network (randomized drops, failures,
+    latency spikes, minority partitions) + client retry; the progress log is
+    then mandatory for liveness and defaults on.
+    """
     rng = RandomSource(seed)
     rf = rf if rf is not None else rng.pick([3, 3, 5])
     n_nodes = nodes if nodes is not None else rng.next_int(rf, 2 * rf)
     key_count = key_count if key_count is not None else rng.next_int(5, 21)
     node_ids = list(range(1, n_nodes + 1))
+    if progress_log is None:
+        progress_log = chaos
 
     # shard the key space into rf-replicated ranges over the nodes
     n_ranges = max(1, n_nodes // max(1, rf // 2))
@@ -72,10 +116,20 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         shards.append(Shard(Range(IntKey(start), IntKey(end)), replicas))
     topology = Topology(1, shards)
 
+    if chaos and link_config is None:
+        from .chaos import RandomizedLinkConfig
+        link_config = RandomizedLinkConfig(rng.fork(), rf,
+                                           interval_s=chaos_interval_s)
     cluster = Cluster(topology, seed=rng.next_long(), num_shards=num_shards,
                       link_config=link_config, delayed_stores=delayed_stores,
                       clock_drift=clock_drift, journal=journal,
-                      resolver=resolver)
+                      resolver=resolver, progress_log=progress_log,
+                      progress_poll_s=progress_poll_s)
+    cluster.tracer = tracer
+    # debugging handle (stall forensics): weak, so finished runs don't pin the
+    # whole cluster graph in a module global
+    import weakref
+    run_burn.last_cluster_ref = weakref.ref(cluster)
     member_ids = sorted(cluster.nodes)  # nodes actually replicating some shard
     churn_task = None
     if topology_churn:
@@ -85,6 +139,19 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         randomizer = TopologyRandomizer(cluster, rng.fork())
         churn_task = cluster.scheduler.recurring(churn_interval_s,
                                                  randomizer.maybe_update_topology)
+    durability_scheduling = []
+    if durability:
+        # scheduled durability + truncation running DURING the burn, with
+        # randomized cadences (Cluster.java:429-445)
+        from ..impl.durability_scheduling import CoordinateDurabilityScheduling
+        shard_cycle = float(rng.next_biased_int(5, 15, 45))
+        global_cycle = float(rng.next_biased_int(10, 30, 90))
+        for node in cluster.nodes.values():
+            sched = CoordinateDurabilityScheduling(
+                node, shard_cycle_time_s=shard_cycle,
+                global_cycle_time_s=global_cycle)
+            sched.start()
+            durability_scheduling.append(sched)
     verifier = StrictSerializabilityVerifier()
     result = BurnResult(seed)
     zipf = rng.next_boolean()
@@ -94,6 +161,63 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         return IntKey((idx * bound) // key_count)
 
     state = {"submitted": 0, "in_flight": 0}
+
+    def resolve(obs: Observation, kind: str, reads=None,
+                writes: Optional[dict] = None) -> None:
+        state["in_flight"] -= 1
+        now = cluster.now_micros
+        if kind == "ok":
+            obs.complete(now, reads or {}, writes or {})
+            result.ops_ok += 1
+        elif kind == "recovered":
+            obs.complete(now, reads or {}, writes or {})
+            result.ops_recovered += 1
+        elif kind == "nacked":
+            obs.invalidated(now, writes or {})
+            result.ops_nacked += 1
+        elif kind == "lost":
+            obs.lost(now)
+            result.ops_lost += 1
+        else:
+            obs.fail(now)
+            result.ops_failed += 1
+        submit_next()
+
+    def probe(coordinator, txn_id, route, obs, writes, attempt: int) -> None:
+        """Client lost-response resolution: CheckStatus the cluster until the
+        txn's fate is known (ListRequest.CheckOnResult, ListRequest.java:61-150)."""
+        from ..coordinate.fetch_data import check_status_quorum
+
+        def retry():
+            if attempt + 1 >= MAX_PROBE_ATTEMPTS:
+                resolve(obs, "failed")
+                return
+            cluster.scheduler.once(0.5 + rng.next_float(),
+                                   lambda: probe(coordinator, txn_id, route, obs,
+                                                 writes, attempt + 1))
+
+        def on_checked(merged, failure):
+            if failure is not None:
+                retry()
+                return
+            ss = merged.save_status if merged is not None else SaveStatus.NOT_DEFINED
+            if ss is SaveStatus.INVALIDATED:
+                resolve(obs, "nacked", writes=writes)
+            elif ss.ordinal >= SaveStatus.APPLIED.ordinal and not ss.is_truncated:
+                reads = dict(merged.result.reads) \
+                    if isinstance(merged.result, ListResult) else {}
+                resolve(obs, "recovered", reads=reads, writes=writes)
+            elif ss.is_truncated:
+                # durably decided and cleaned up; outcome unknowable → Lost-class
+                resolve(obs, "lost")
+            elif not ss.has_been(Status.PRE_ACCEPTED):
+                # a quorum answered and nothing witnessed it
+                resolve(obs, "lost")
+            else:
+                retry()  # in flight somewhere; let recovery settle it
+
+        check_status_quorum(coordinator, txn_id, route, include_info=True) \
+            .to_chain().begin(on_checked)
 
     def submit_next() -> None:
         while state["in_flight"] < concurrency and state["submitted"] < ops:
@@ -122,37 +246,52 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                     if kind in ("write", "rw") else {}
                 txn = list_txn(reads, writes)
             coordinator = cluster.nodes[rng.pick(member_ids)]
+            txn_id = coordinator.next_txn_id(txn.kind, txn.domain)
+            route = txn.to_route()
             obs = verifier.begin(cluster.now_micros)
+            if on_submit is not None:
+                on_submit(op_id, txn_id, txn, coordinator.id)
 
-            def on_done(value, failure, obs=obs, writes=writes):
-                state["in_flight"] -= 1
-                if failure is not None or not isinstance(value, ListResult):
-                    obs.fail(cluster.now_micros)
-                    result.ops_failed += 1
+            def on_done(value, failure, obs=obs, writes=writes,
+                        coordinator=coordinator, txn_id=txn_id, route=route):
+                if failure is None and isinstance(value, ListResult):
+                    resolve(obs, "ok", reads=dict(value.reads),
+                            writes=dict(writes))
+                elif isinstance(failure, Invalidated):
+                    resolve(obs, "nacked", writes=dict(writes))
+                elif chaos or isinstance(failure, CoordinationFailed):
+                    # response lost in the chaos: resolve through the home shard
+                    probe(coordinator, txn_id, route, obs, dict(writes), 0)
                 else:
-                    obs.complete(cluster.now_micros,
-                                 dict(value.reads), dict(writes))
-                    result.ops_ok += 1
-                submit_next()
+                    resolve(obs, "failed")
 
-            coordinator.coordinate(txn).add_listener(on_done)
+            coordinator.coordinate(txn, txn_id=txn_id).add_listener(on_done)
     submit_next()
 
     try:
-        cluster.run_until(lambda: result.ops_ok + result.ops_failed >= ops,
-                          max_tasks=5_000_000)
+        cluster.run_until(lambda: result.resolved >= ops, max_tasks=max_tasks)
+        # quiesce: stop chaos/churn/durability so the cluster can settle
+        # (the reference's noMoreWorkSignal, Cluster.java:470-475)
         if churn_task is not None:
-            churn_task.cancel()  # stop mutating so the cluster can quiesce
-        cluster.run_until_idle(max_tasks=5_000_000)
+            churn_task.cancel()
+        for sched in durability_scheduling:
+            sched.stop()
+        if hasattr(cluster.link, "heal"):
+            cluster.link.heal()
+        cluster.run_until_idle(max_tasks=max_tasks)
         result.ops_submitted = state["submitted"]
         result.sim_micros = cluster.now_micros
         result.stats = dict(cluster.stats)
-        if result.ops_ok + result.ops_failed < ops:
+        if result.resolved < ops:
             raise HistoryViolation(
-                f"only {result.ops_ok + result.ops_failed}/{ops} ops resolved "
-                f"(liveness stall)")
+                f"only {result.resolved}/{ops} ops resolved (liveness stall): "
+                f"{result!r}")
         if not allow_failures and result.ops_failed:
-            raise HistoryViolation(f"{result.ops_failed} ops failed under a benign network")
+            raise HistoryViolation(f"{result.ops_failed} ops failed unexpectedly")
+        if not chaos and (result.ops_lost or result.ops_recovered
+                          or (not allow_failures and result.ops_nacked)):
+            raise HistoryViolation(
+                f"benign network must ack everything: {result!r}")
         # final replica state must agree per key across replicas covering it
         # (under churn, judge against the FINAL topology's replica sets)
         final: Dict[IntKey, tuple] = {}
@@ -187,8 +326,10 @@ def reconcile(seed: int, **kwargs) -> None:
     catches nondeterminism itself (BurnTest.reconcile, ReconcilingLogger)."""
     a = run_burn(seed, **kwargs)
     b = run_burn(seed, **kwargs)
-    assert (a.ops_ok, a.ops_failed, a.sim_micros) == \
-           (b.ops_ok, b.ops_failed, b.sim_micros), \
+    assert (a.ops_ok, a.ops_recovered, a.ops_nacked, a.ops_lost, a.ops_failed,
+            a.sim_micros) == \
+           (b.ops_ok, b.ops_recovered, b.ops_nacked, b.ops_lost, b.ops_failed,
+            b.sim_micros), \
         f"nondeterministic outcome for seed {seed}: {a} vs {b}"
     assert a.stats == b.stats, \
         f"nondeterministic message counts for seed {seed}: " \

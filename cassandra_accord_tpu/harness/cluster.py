@@ -103,18 +103,22 @@ class SimScheduler(Scheduler):
                 entry.cancel()
         return _S()
 
-    def recurring(self, interval_s: float, run: Callable[[], None]):
+    def recurring(self, interval_s, run: Callable[[], None]):
+        """``interval_s`` may be a float or a zero-arg callable resampled every
+        cycle (jittered cadences — breaks cross-node poll alignment that would
+        otherwise make concurrent recovery attempts perpetually preempt each
+        other; the reference randomizes its progress-log requeue delays)."""
         state = {"cancelled": False, "entry": None}
+        next_us = (lambda: int(interval_s() * 1_000_000)) if callable(interval_s) \
+            else (lambda: int(interval_s * 1_000_000))
 
         def fire():
             if state["cancelled"]:
                 return
             run()
-            state["entry"] = self.queue.add_after(int(interval_s * 1_000_000), fire,
-                                                  recurring=True)
+            state["entry"] = self.queue.add_after(next_us(), fire, recurring=True)
 
-        state["entry"] = self.queue.add_after(int(interval_s * 1_000_000), fire,
-                                              recurring=True)
+        state["entry"] = self.queue.add_after(next_us(), fire, recurring=True)
 
         class _S(Scheduler.Scheduled):
             def cancel(self_inner):
@@ -344,6 +348,10 @@ class Cluster:
         self.queue = PendingQueue()
         self.scheduler = SimScheduler(self.queue)
         self.topologies: List[Topology] = [topology]
+        # message trace hook: fn(event, from, to, msg_id, message, now_micros)
+        # where event is the link action taken or "REPLY"/"REPLY_<action>"
+        # (the reference's accord.impl.basic.Trace logger, Cluster.java:237-264)
+        self.tracer: Optional[Callable] = None
         self.link = link_config or LinkConfig(self.rng.fork())
         self.reply_timeout_s = reply_timeout_s
         self.failures: List[BaseException] = []
@@ -386,6 +394,9 @@ class Cluster:
             for node in self.nodes.values():
                 for store in node.command_stores.all_stores():
                     self.journal.attach(store)
+        # chaos link configs re-randomize themselves off the cluster queue
+        if hasattr(self.link, "attach"):
+            self.link.attach(self)
 
     def _start_drift(self, node_id: int) -> None:
         """Random-walk clock drift: small 50µs-5ms jumps, occasional 1-10ms
@@ -422,6 +433,9 @@ class Cluster:
         self._count(f"{type(request).__name__}")
         action = self.link.action(from_node, to_node, request) if from_node != to_node \
             else LinkConfig.DELIVER
+        if self.tracer is not None:
+            self.tracer(action.upper(), from_node, to_node, msg_id, request,
+                        self.queue.now_micros)
         if action in (LinkConfig.DROP, LinkConfig.FAILURE):
             if action == LinkConfig.FAILURE and has_callback:
                 self.queue.add_after(
@@ -444,6 +458,9 @@ class Cluster:
         self._count(f"{type(reply).__name__}")
         action = self.link.action(from_node, to_node, reply) if from_node != to_node \
             else LinkConfig.DELIVER
+        if self.tracer is not None:
+            self.tracer(f"RPLY_{action.upper()}", from_node, to_node,
+                        reply_context.msg_id, reply, self.queue.now_micros)
         if action in (LinkConfig.DROP, LinkConfig.FAILURE):
             return
         latency = 0 if from_node == to_node else self.link.latency_us(from_node, to_node)

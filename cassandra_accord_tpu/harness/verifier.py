@@ -28,9 +28,20 @@ class HistoryViolation(AssertionError):
 
 
 class Observation:
-    """One client txn's visible behavior."""
+    """One client txn's visible behavior.
 
-    __slots__ = ("op_id", "submit_time", "complete_time", "reads", "writes", "failed")
+    Outcomes mirror the reference burn's client accounting
+    (BurnTest.java:426-447, ListRequest Outcome.Kind):
+
+    - ``ok``: acknowledged with its reads/writes — fully constrained;
+    - ``lost``: resolved, but unknown whether it applied (response lost and no
+      replica evidence) — unconstrained, its writes MAY appear;
+    - ``invalidated``: durably invalidated — its writes must NEVER appear;
+    - ``failed``: unexpected failure (burns treat any as fatal).
+    """
+
+    __slots__ = ("op_id", "submit_time", "complete_time", "reads", "writes",
+                 "outcome")
 
     def __init__(self, op_id: int, submit_time: int):
         self.op_id = op_id
@@ -38,17 +49,31 @@ class Observation:
         self.complete_time: Optional[int] = None
         self.reads: Dict[Key, Tuple] = {}       # key -> observed list
         self.writes: Dict[Key, object] = {}     # key -> unique appended value
-        self.failed = False
+        self.outcome: Optional[str] = None
 
     def complete(self, complete_time: int, reads: Dict[Key, Tuple],
                  writes: Dict[Key, object]) -> None:
         self.complete_time = complete_time
         self.reads = reads
         self.writes = writes
+        self.outcome = "ok"
 
     def fail(self, complete_time: int) -> None:
         self.complete_time = complete_time
-        self.failed = True
+        self.outcome = "failed"
+
+    def lost(self, complete_time: int) -> None:
+        self.complete_time = complete_time
+        self.outcome = "lost"
+
+    def invalidated(self, complete_time: int, writes: Dict[Key, object]) -> None:
+        self.complete_time = complete_time
+        self.writes = writes
+        self.outcome = "invalidated"
+
+    @property
+    def failed(self) -> bool:
+        return self.outcome == "failed"
 
 
 class StrictSerializabilityVerifier:
@@ -64,17 +89,37 @@ class StrictSerializabilityVerifier:
 
     # ------------------------------------------------------------------
     def verify(self, final_state: Optional[Dict[Key, Tuple]] = None) -> None:
-        done = [o for o in self.observations if o.complete_time is not None and not o.failed]
+        done = [o for o in self.observations if o.outcome == "ok"]
         self._check_response_accounting()
         orders = self._check_prefix_consistency(done, final_state)
         self._check_real_time(done, orders)
         self._check_atomicity(done)
+        self._check_invalidated_never_applied(done, final_state)
 
     # -- 0: every op resolved ------------------------------------------------
     def _check_response_accounting(self) -> None:
-        unresolved = [o.op_id for o in self.observations if o.complete_time is None]
+        unresolved = [o.op_id for o in self.observations if o.outcome is None]
         if unresolved:
             raise HistoryViolation(f"ops never resolved: {unresolved}")
+
+    # -- 4: invalidated writes never visible ---------------------------------
+    def _check_invalidated_never_applied(self, done: List["Observation"],
+                                         final_state: Optional[Dict[Key, Tuple]]) -> None:
+        visible = set()
+        for o in done:
+            for lst in o.reads.values():
+                visible.update(lst)
+        if final_state:
+            for lst in final_state.values():
+                visible.update(lst)
+        for o in self.observations:
+            if o.outcome != "invalidated":
+                continue
+            for key, value in o.writes.items():
+                if value in visible:
+                    raise HistoryViolation(
+                        f"op {o.op_id} was durably invalidated but its write "
+                        f"{value!r} to {key} is visible")
 
     # -- 1: per-key prefix order --------------------------------------------
     def _check_prefix_consistency(self, done: List[Observation],

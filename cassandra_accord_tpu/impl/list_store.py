@@ -27,6 +27,12 @@ class ListStore(DataStore):
     def get(self, key: Key) -> Tuple[object, ...]:
         return tuple(v for _, v in self.data.get(key, ()))
 
+    def get_at(self, key: Key, execute_at: Timestamp) -> Tuple[object, ...]:
+        """Snapshot read: entries applied at-or-before ``execute_at`` only.
+        Keeps reads correct even when a write with a LATER executeAt landed
+        early (truncated-outcome adoption applies out of dependency order)."""
+        return tuple(v for ts, v in self.data.get(key, ()) if ts <= execute_at)
+
     def append(self, key: Key, execute_at: Timestamp, value: object) -> None:
         entries = self.data.setdefault(key, [])
         # idempotent: the same (executeAt, value) may be applied once
@@ -85,6 +91,16 @@ class ListStore(DataStore):
 
         store = self
         remaining = {"n": len(plan)}
+        # the fence shipment (Apply.Maximal of the sync point) depends only on
+        # the sync point — build it once for all slices/failover retries
+        from ..messages.txn_messages import Apply, ApplyOk, ApplyThenWaitUntilApplied
+        from ..primitives.timestamp import TxnKind
+        from ..primitives.txn import Txn
+        fence_parts = sync_point.route.participants()
+        fence_txn = Txn.empty(TxnKind.EXCLUSIVE_SYNC_POINT, fence_parts)
+        fence_partial = fence_txn.slice(fence_parts, include_query=False)
+        fence_writes = fence_txn.execute(sync_point.txn_id,
+                                         sync_point.execute_at, None)
 
         def fetch_slice(sub: Ranges, candidates, i: int) -> None:
             class FetchCallback(Callback):
@@ -106,9 +122,32 @@ class ListStore(DataStore):
                     else:
                         fetch_ranges.fail(failure)
 
-            node.send(candidates[i],
-                      FetchStoreData(sub, sync_point.txn_id, sync_point.route),
-                      FetchCallback())
+            # ship the fence to the source FIRST (Apply.Maximal + wait-applied):
+            # a source outside the fence's current-epoch topology (the replica
+            # the range is moving AWAY from) never hears of it otherwise, and
+            # data is only complete up to an APPLIED fence
+            # (impl/AbstractFetchCoordinator.java — ApplyThenWaitUntilApplied)
+            fetch_cb = FetchCallback()
+
+            class FenceCallback(Callback):
+                def on_success(self, from_node: int, reply) -> None:
+                    if not isinstance(reply, ApplyOk):
+                        self.on_failure(from_node,
+                                        RuntimeError(f"fence not applied: {reply!r}"))
+                        return
+                    node.send(from_node,
+                              FetchStoreData(sub, sync_point.txn_id,
+                                             sync_point.route),
+                              fetch_cb)
+
+                def on_failure(self, from_node: int, failure: BaseException) -> None:
+                    fetch_cb.on_failure(from_node, failure)
+
+            node.send(candidates[i], ApplyThenWaitUntilApplied(
+                sync_point.txn_id, sync_point.route, sync_point.txn_id.epoch,
+                Apply.MAXIMAL, sync_point.execute_at,
+                sync_point.deps, fence_partial,
+                fence_writes, None, route=sync_point.route), FenceCallback())
 
         for sub, candidates in plan:
             fetch_slice(sub, candidates, 0)
@@ -140,7 +179,7 @@ class ListRead(Read):
         return self._keys
 
     def read(self, key, safe_store, execute_at, data_store) -> au.AsyncChain:
-        return au.done(ListData({key: data_store.get(key)}))
+        return au.done(ListData({key: data_store.get_at(key, execute_at)}))
 
     def slice(self, ranges: Ranges) -> "ListRead":
         return ListRead(self._keys.slice(ranges))
@@ -160,7 +199,8 @@ class ListRangeRead(Read):
         return self._ranges
 
     def read(self, rng, safe_store, execute_at, data_store) -> au.AsyncChain:
-        entries = {key: data_store.get(key) for key in data_store.keys_in(rng)}
+        entries = {key: data_store.get_at(key, execute_at)
+                   for key in data_store.keys_in(rng)}
         return au.done(ListData(entries))
 
     def slice(self, ranges: Ranges) -> "ListRangeRead":

@@ -40,14 +40,38 @@ class Progress(enum.Enum):
 
 class _MonitorState:
     """Per-txn monitoring record, used both for home-shard coordination
-    monitoring and for blocked-dependency resolution."""
-    __slots__ = ("txn_id", "route", "progress", "token")
+    monitoring and for blocked-dependency resolution.
+
+    ``backoff``/``cooldown``: after a failed investigation (preempted, quorum
+    unreachable) the monitor sits out an exponentially growing number of polls
+    before escalating again — without this, several nodes monitoring the same
+    stuck txn perpetually preempt each other's recovery/invalidation ballots
+    (the reference staggers its retries through randomized requeue delays,
+    SimpleProgressLog.java)."""
+    __slots__ = ("txn_id", "route", "progress", "token", "backoff", "cooldown")
 
     def __init__(self, txn_id: TxnId, route: Route):
         self.txn_id = txn_id
         self.route = route
         self.progress = Progress.EXPECTED
         self.token = None
+        self.backoff = 0
+        self.cooldown = 0
+
+    def investigation_failed(self) -> None:
+        self.backoff = min(self.backoff * 2 + 1, 8)
+        self.cooldown = self.backoff
+        self.progress = Progress.NO_PROGRESS
+
+    def investigation_progressed(self) -> None:
+        self.backoff = 0
+        self.cooldown = 0
+
+    def in_cooldown(self) -> bool:
+        if self.cooldown > 0:
+            self.cooldown -= 1
+            return True
+        return False
 
 
 _CoordinateState = _MonitorState
@@ -75,7 +99,12 @@ class SimpleProgressLog(ProgressLog):
         self.coordinating: Dict[TxnId, _CoordinateState] = {}
         self.blocking: Dict[TxnId, _BlockingState] = {}
         self.non_home: Dict[TxnId, _NonHomeState] = {}
-        self._scheduled = self.node.scheduler.recurring(poll_interval_s, self._poll)
+        # jittered cadence: [0.6, 1.6) × base, resampled per cycle, so polls of
+        # different stores/nodes never stay aligned (cross-node recovery
+        # collisions would otherwise livelock on mutual preemption)
+        rng = self.node.random.fork()
+        interval = lambda: poll_interval_s * (0.6 + rng.next_float())  # noqa: E731
+        self._scheduled = self.node.scheduler.recurring(interval, self._poll)
 
     def close(self) -> None:
         self._scheduled.cancel()
@@ -170,6 +199,8 @@ class SimpleProgressLog(ProgressLog):
                 state.token = local_token
                 state.progress = Progress.EXPECTED
                 continue
+            if state.in_cooldown():
+                continue
             state.progress = Progress.INVESTIGATING
             self._investigate(state)
 
@@ -184,6 +215,8 @@ class SimpleProgressLog(ProgressLog):
             if state.progress is Progress.EXPECTED:
                 # freshly blocked: give the normal pipeline one poll cycle
                 state.progress = Progress.NO_PROGRESS
+                continue
+            if state.in_cooldown():
                 continue
             state.progress = Progress.INVESTIGATING
             self._resolve_blocked(state)
@@ -214,11 +247,15 @@ class SimpleProgressLog(ProgressLog):
             current = self.coordinating.get(state.txn_id)
             if failure is not None:
                 if current is not None:
-                    current.progress = Progress.NO_PROGRESS
+                    current.investigation_failed()
                 return
             if outcome.settled:
                 self._done(state.txn_id)
             elif current is not None:
+                if outcome.token.advanced_from(current.token):
+                    current.investigation_progressed()
+                else:
+                    current.investigation_failed()
                 current.token = outcome.token
                 current.progress = Progress.EXPECTED
 
@@ -242,7 +279,7 @@ class SimpleProgressLog(ProgressLog):
             if current is None:
                 return
             if failure is not None:
-                current.progress = Progress.NO_PROGRESS
+                current.investigation_failed()
                 return
             # fetch_data propagated any knowledge found; resolved iff the dep is
             # now APPLIED (or settled) *locally* — being merely (pre)committed
@@ -253,6 +290,7 @@ class SimpleProgressLog(ProgressLog):
                 return
             token = ProgressToken.of(merged) if merged is not None else None
             if token is not None and token.advanced_from(current.token):
+                current.investigation_progressed()
                 current.token = token
                 current.progress = Progress.NO_PROGRESS  # escalate next poll if stalled
                 return
@@ -260,20 +298,29 @@ class SimpleProgressLog(ProgressLog):
             # stalled and undecided: settle it
             rec = au.settable()
             txn = merged.full_txn() if merged is not None else None
-            full_route = merged.route if merged is not None and merged.route is not None \
-                and merged.route.full else state.route
+            if merged is not None and merged.route is not None and merged.route.full:
+                full_route = merged.route
+            elif txn is not None:
+                # reconstituted definition: recover over the txn's REAL
+                # footprint — a partial hint would slice recovery to one shard
+                # and stall it forever (empty partials at the others)
+                full_route = self.node.compute_route(txn)
+            else:
+                full_route = state.route
             if txn is not None:
                 do_recover(self.node, state.txn_id, txn, full_route, rec)
             else:
                 do_invalidate(self.node, state.txn_id, full_route, rec)
 
             def on_settled(_value, rec_failure):
+                from ..coordinate.errors import Truncated
                 cur = self.blocking.get(state.txn_id)
                 if cur is not None:
-                    if rec_failure is None or isinstance(rec_failure, Invalidated):
+                    if rec_failure is None or isinstance(rec_failure,
+                                                        (Invalidated, Truncated)):
                         self.blocking.pop(state.txn_id, None)
                     else:
-                        cur.progress = Progress.NO_PROGRESS
+                        cur.investigation_failed()
             rec.add_listener(on_settled)
 
         fetch_data(self.node, state.txn_id, state.route).add_listener(on_fetched)

@@ -35,18 +35,36 @@ class Bootstrap:
 
     def start(self) -> au.AsyncResult:
         self.store.pending_bootstrap = self.store.pending_bootstrap.union(self.ranges)
-        sp_result = self.node.sync_point(self.ranges, exclusive=True, blocking=True)
-        sp_result.add_listener(self._on_sync_point)
+        self._attempt()
         return self.result
+
+    def _attempt(self) -> None:
+        """One sync-point attempt.  The fence id is allocated FIRST and
+        ``bootstrapped_at`` marked with it BEFORE coordination
+        (Bootstrap.java markBootstrapping): the bootstrapping store then elides
+        pre-bootstrap dependencies — the fetched snapshot covers them — so the
+        fence itself (and txns committed during bootstrap) can apply here.
+        Without the early mark, a blocking fence over ranges whose replica set
+        fully changed deadlocks: its apply quorum needs the NEW replicas, whose
+        applies wait on data only the post-fence fetch can deliver."""
+        from ..primitives.timestamp import Domain, TxnKind
+        txn_id = self.node.next_txn_id(TxnKind.EXCLUSIVE_SYNC_POINT, Domain.RANGE)
+
+        def mark(safe_store):
+            from .durability import RedundantBefore
+            self.store.redundant_before = self.store.redundant_before.merge(
+                RedundantBefore.of(self.ranges, bootstrapped_at=txn_id))
+            _reevaluate_waiting(safe_store)
+            self.node.sync_point(self.ranges, exclusive=True, blocking=True,
+                                 txn_id=txn_id).add_listener(self._on_sync_point)
+
+        self.store.execute(mark)
 
     def _on_sync_point(self, sync_point, failure) -> None:
         if failure is not None:
             # retry ladder (Bootstrap.Attempt): the agent decides; default retries
             def retry():
-                self.node.scheduler.once(
-                    0.5, lambda: self.node.sync_point(self.ranges, exclusive=True,
-                                                      blocking=True)
-                    .add_listener(self._on_sync_point))
+                self.node.scheduler.once(0.5, self._attempt)
             self.node.agent.on_failed_bootstrap("sync point", self.ranges, retry,
                                                 failure)
             return

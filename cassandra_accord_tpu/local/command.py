@@ -65,7 +65,7 @@ class Command:
         "txn_id", "save_status", "durability",
         "route", "partial_txn", "partial_deps",
         "promised", "accepted_or_committed",
-        "execute_at", "writes", "result",
+        "execute_at", "execute_at_least", "writes", "result",
         "waiting_on", "listeners",
     )
 
@@ -81,6 +81,12 @@ class Command:
         # accepted_or_committed: ballot at which executeAt/deps were accepted
         self.accepted_or_committed: Ballot = Ballot.ZERO
         self.execute_at: Optional[Timestamp] = None
+        # awaits-only-deps txns (sync points) with a dependency deciding a
+        # LATER executeAt defer their effective local execution past it
+        # (WaitingOn.Update.updateExecuteAtLeast, Commands.java:727-728) —
+        # ordinary waiters order against max(execute_at, execute_at_least),
+        # which is what breaks the fence<->write wait cycle
+        self.execute_at_least: Optional[Timestamp] = None
         self.writes: Optional[Writes] = None
         self.result = None
         self.waiting_on: Optional[WaitingOn] = None
@@ -121,6 +127,14 @@ class Command:
 
     def execute_at_if_known(self) -> Optional[Timestamp]:
         return self.execute_at if self.has_been(Status.PRE_COMMITTED) else None
+
+    def effective_execute_at(self) -> Optional[Timestamp]:
+        """Execution-ordering timestamp as seen by waiters: executeAt, deferred
+        past execute_at_least for awaits-only-deps commands."""
+        if self.execute_at_least is not None and (
+                self.execute_at is None or self.execute_at_least > self.execute_at):
+            return self.execute_at_least
+        return self.execute_at
 
     def __repr__(self) -> str:
         return f"Command({self.txn_id!r}, {self.save_status.name}, @{self.execute_at!r})"

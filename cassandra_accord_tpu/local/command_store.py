@@ -206,8 +206,16 @@ class SafeCommandStore:
                 for tid, (rngs, status) in self.store.range_txns.items():
                     if tid < before and status is not InternalStatus.INVALIDATED \
                             and (fence is None or not tid < fence) \
-                            and witnesses(tid) and rngs.intersects(rng):
-                        visit(rng, tid)
+                            and witnesses(tid):
+                        # record the dep against the OVERLAP with its own
+                        # footprint, not the querier's whole range: deps sliced
+                        # to another store must not carry txns that never touch
+                        # it (they would wait forever for an apply that cannot
+                        # happen there) — RangeDeps participant semantics
+                        for piece in rngs:
+                            x = piece.intersection(rng)
+                            if x is not None:
+                                visit(x, tid)
 
     def max_conflict(self, keys, ranges) -> Optional[Timestamp]:
         """Max txnId/executeAt witnessed intersecting the footprint (MaxConflicts)."""

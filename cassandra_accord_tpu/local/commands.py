@@ -106,6 +106,11 @@ def recover(safe_store: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn
     command.promised = command.promised.merge_max(ballot)
     if not command.has_been(Status.PRE_ACCEPTED):
         outcome = preaccept(safe_store, txn_id, partial_txn, route, ballot)
+        if outcome is AcceptOutcome.TRUNCATED:
+            # the region (or the txn) is below this store's redundancy bound:
+            # report truncated — asserting here kills the reply and starves the
+            # recovery quorum forever
+            return AcceptOutcome.TRUNCATED
         check_state(outcome is AcceptOutcome.SUCCESS,
                     "recovery preaccept failed with %s", outcome)
     return AcceptOutcome.SUCCESS
@@ -138,9 +143,16 @@ def accept(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: R
     return AcceptOutcome.SUCCESS
 
 
-def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot) -> AcceptOutcome:
+def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot,
+                      scope: Optional[Route] = None) -> AcceptOutcome:
     """Promise not to accept anything below ballot, voting for invalidation
     (Commands.java:250)."""
+    if _is_shard_redundant(safe_store, txn_id, scope):
+        # GC erased this txn because it (and everything before it) durably
+        # applied at every replica — answering NOT_DEFINED here would let a
+        # quorum of erased replicas invalidate an already-applied txn
+        # (ErasedSafeCommand tombstone semantics)
+        return AcceptOutcome.TRUNCATED
     command = safe_store.get_or_create(txn_id)
     if command.save_status.is_truncated:
         return AcceptOutcome.TRUNCATED
@@ -149,6 +161,11 @@ def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballo
     if ballot < command.promised:
         return AcceptOutcome.REJECTED_BALLOT
     command.promised = command.promised.merge_max(ballot)
+    # the invalidation vote is an ACCEPT-phase decision at ``ballot``: recovery
+    # must rank it against competing Accepts BY BALLOT (an AcceptedInvalidate
+    # at a later ballot supersedes an Accept at an earlier one — otherwise a
+    # recoverer re-proposes the txn while the invalidator commit-invalidates)
+    command.accepted_or_committed = command.accepted_or_committed.merge_max(ballot)
     if command.save_status < SaveStatus.ACCEPTED_INVALIDATE:
         command.set_save_status(SaveStatus.ACCEPTED_INVALIDATE)
     safe_store.journal_save(command)
@@ -224,8 +241,45 @@ def commit(safe_store: SafeCommandStore, txn_id: TxnId, save_status: SaveStatus,
     return CommitOutcome.SUCCESS
 
 
-def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId) -> None:
+def adopt_truncated_outcome(safe_store: SafeCommandStore, command: Command,
+                            route: Route, execute_at: Timestamp, writes,
+                            result) -> None:
+    """The cluster truncated this txn AFTER it applied and the outcome is still
+    carried (TRUNCATE_WITH_OUTCOME): a lagging replica adopts it directly —
+    writes land out of dependency order (safe: the data store orders entries by
+    executeAt and applies idempotently; reads snapshot at their own executeAt)
+    and the command becomes a truncated tombstone, unblocking local waiters
+    (the reference's Propagate handling of truncated evidence, Propagate.java;
+    Infer.safeToCleanup)."""
+    command.route = route if command.route is None else command.route
+    command.execute_at = execute_at
+    command.writes = writes
+    command.result = result
+
+    def post(_=None, failure=None):
+        if failure is not None:
+            safe_store.agent().on_uncaught_exception(failure)
+            return
+        command.partial_txn = None
+        command.partial_deps = None
+        command.waiting_on = None
+        command.set_save_status(SaveStatus.TRUNCATED_APPLY)
+        safe_store.journal_save(command)
+        safe_store.register_witness(command, InternalStatus.APPLIED)
+        safe_store.progress_log().clear(command.txn_id)
+        safe_store.notify_listeners(command)
+
+    if writes is None or writes.is_empty():
+        post()
+    else:
+        writes.apply_to(safe_store, safe_store.store.all_ranges()).begin(post)
+
+
+def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId,
+                      scope: Optional[Route] = None) -> None:
     """Commands.java:434."""
+    if _is_shard_redundant(safe_store, txn_id, scope):
+        return   # erased-tombstone guard: the txn durably applied everywhere
     command = safe_store.get_or_create(txn_id)
     if command.has_been(Status.PRE_COMMITTED) and command.save_status is not SaveStatus.INVALIDATED:
         # a txn cannot be both committed and invalidated
@@ -287,6 +341,7 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
         return
     execute_at = command.execute_at
     waiting = set()
+    deferred = False
     local_ranges = safe_store.store.all_ranges()
     deps = command.partial_deps.slice(local_ranges) if command.partial_deps is not None else Deps.NONE
     redundant = safe_store.redundant_before()
@@ -311,11 +366,60 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
         dep_parts = deps.participants(dep_id)
         if dep_parts is not None and redundant.is_locally_redundant(dep_id, dep_parts):
             continue
+        if dep_parts is not None and not _participates_at_epoch(safe_store, dep_id,
+                                                               dep_parts):
+            # this store does not own the dep's footprint at the dep's epoch:
+            # the dep will never be applied HERE (its Apply targets that
+            # epoch's replicas) — waiting would deadlock topology-spanning
+            # commands (StoreParticipants execution gating)
+            continue
         if _still_blocks(safe_store, command, dep_id, execute_at):
             waiting.add(dep_id)
             dep = safe_store.get_or_create(dep_id)
             dep.listeners.add(command.txn_id)
+            deferred |= _maybe_defer_execute_at_least(safe_store, command, dep,
+                                                     notify=False)
     command.waiting_on = WaitingOn(waiting)
+    if deferred:
+        safe_store.notify_listeners(command)
+
+
+def _participates_at_epoch(safe_store: SafeCommandStore, dep_id: TxnId,
+                           dep_parts) -> bool:
+    """Does this store own any of the dep's footprint at the dep's epoch?"""
+    owned = safe_store.store.ranges_at(dep_id.epoch)
+    if not owned:
+        return False
+    keys, rngs = dep_parts
+    for k in keys:
+        if owned.contains(k):
+            return True
+    for r in rngs:
+        if owned.intersects(Ranges.of(r)):
+            return True
+    return False
+
+
+def _maybe_defer_execute_at_least(safe_store: SafeCommandStore, waiter: Command,
+                                  dep: Command, notify: bool = True) -> bool:
+    """An awaits-only-deps waiter (sync point) whose dep decided an executeAt
+    AFTER the waiter's id defers its effective execution past that dep
+    (updateExecuteAtLeast, Commands.java:727-728).  Ordinary txns then order
+    against the DEFERRED time and stop waiting on the sync point — breaking
+    the fence→later-write→earlier-write→fence wait cycle."""
+    if not waiter.txn_id.awaits_only_deps:
+        return False
+    if not dep.has_been(Status.PRE_COMMITTED) or dep.execute_at is None:
+        return False
+    if dep.execute_at > waiter.txn_id.as_timestamp():
+        cur = waiter.execute_at_least
+        if cur is None or dep.execute_at > cur:
+            waiter.execute_at_least = dep.execute_at
+            if notify:
+                # waiters ordering against us must re-evaluate
+                safe_store.notify_listeners(waiter)
+            return True
+    return False
 
 
 def _still_blocks(safe_store: SafeCommandStore, command: Command, dep_id: TxnId,
@@ -326,9 +430,10 @@ def _still_blocks(safe_store: SafeCommandStore, command: Command, dep_id: TxnId,
     if dep.save_status in (SaveStatus.APPLIED, SaveStatus.INVALIDATED) \
             or dep.save_status.is_truncated:
         return False
-    if dep.has_been(Status.PRE_COMMITTED) and not command.txn_id.awaits_only_deps \
-            and dep.execute_at is not None and dep.execute_at > execute_at:
-        return False  # dep executes after us
+    if dep.has_been(Status.PRE_COMMITTED) and not command.txn_id.awaits_only_deps:
+        dep_ea = dep.effective_execute_at()
+        if dep_ea is not None and dep_ea > execute_at:
+            return False  # dep executes (or was deferred to execute) after us
     return True
 
 
@@ -338,11 +443,40 @@ def update_dependency_and_maybe_execute(safe_store: SafeCommandStore, waiter: Co
     (Commands.java:777)."""
     if waiter.waiting_on is None or not waiter.waiting_on.is_waiting_on(dep.txn_id):
         return
+    _maybe_defer_execute_at_least(safe_store, waiter, dep)
     if not _still_blocks(safe_store, waiter, dep.txn_id, waiter.execute_at):
         applied = dep.save_status is SaveStatus.APPLIED or dep.save_status.is_truncated
         waiter.waiting_on.remove(dep.txn_id, applied)
         dep.listeners.discard(waiter.txn_id)
         maybe_execute(safe_store, waiter, always_notify_listeners=False)
+
+
+def _root_blocker(safe_store: SafeCommandStore, command: Command):
+    """Walk the LOCAL dependency graph down from ``command`` to a root blocker:
+    a txn that is not itself locally waiting on anything (unwitnessed here,
+    or committed/stable with a drained frontier but never applied).  Escalating
+    the ROOT is what makes blocked-progress resolution converge — driving an
+    intermediate (itself-blocked) dependency just re-commits it without
+    unblocking anyone (the reference's NotifyWaitingOn graph walk,
+    Commands.java:617-775).  Returns (root_txn_id, parent_command) where
+    ``parent`` is the waiter one level above the root (for route/participant
+    hints)."""
+    cur = command
+    visited = {command.txn_id}
+    while True:
+        nxt_id = None
+        for cand in cur.waiting_on.waiting:
+            if cand not in visited:
+                nxt_id = cand
+                break
+        if nxt_id is None:
+            # fully-visited cycle: fall back to any member
+            return next(iter(cur.waiting_on.waiting)), cur
+        visited.add(nxt_id)
+        nxt = safe_store.get_if_exists(nxt_id)
+        if nxt is None or nxt.waiting_on is None or not nxt.waiting_on.is_waiting():
+            return nxt_id, cur
+        cur = nxt
 
 
 def maybe_execute(safe_store: SafeCommandStore, command: Command,
@@ -356,15 +490,16 @@ def maybe_execute(safe_store: SafeCommandStore, command: Command,
         # capture the blocking dep BEFORE notifying: notification can re-enter
         # this command (a dependent applies, notifying its listeners, which may
         # include us) and drain waiting_on under our feet
-        blocking = next(iter(command.waiting_on.waiting))
+        blocking, parent = _root_blocker(safe_store, command)
         if always_notify_listeners:
             safe_store.notify_listeners(command)
             if command.save_status not in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
                 return False  # re-entrant notification already advanced us
         if command.waiting_on.is_waiting():
-            participants = command.partial_deps.participants(blocking) \
-                if command.partial_deps is not None else None
-            safe_store.progress_log().waiting(blocking, None, command.route, participants)
+            participants = parent.partial_deps.participants(blocking) \
+                if parent is not None and parent.partial_deps is not None else None
+            route = parent.route if parent is not None else command.route
+            safe_store.progress_log().waiting(blocking, None, route, participants)
             return False
         # frontier drained during notification but no one executed us: fall through
 
@@ -425,11 +560,11 @@ def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
         command.partial_txn = None
         command.partial_deps = None
         command.waiting_on = None
+        safe_store.notify_listeners(command)
         command.listeners.clear()
         return
     command.partial_deps = None
     command.waiting_on = None
-    command.listeners.clear()
     if cleanup is Cleanup.TRUNCATE_WITH_OUTCOME:
         command.partial_txn = None
         command.set_save_status(SaveStatus.TRUNCATED_APPLY)
@@ -444,6 +579,11 @@ def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
         command.result = None
         command.set_save_status(SaveStatus.ERASED)
     safe_store.journal_save(command)
+    # waiters must LEARN of the truncation (a truncated dep no longer blocks,
+    # _still_blocks) — clearing their registrations silently would strand them
+    # in waiting_on forever
+    safe_store.notify_listeners(command)
+    command.listeners.clear()
 
 
 # ---------------------------------------------------------------------------

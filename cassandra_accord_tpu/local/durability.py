@@ -154,11 +154,26 @@ class RedundantBefore:
                 if fence is not None:
                     add(key, fence)
         if ranges is not None:
+            from ..primitives.keys import Range as _Range
             for rng in ranges:
-                for e in self.map.values_over(rng.start, rng.end):
+                # attribute each fence to ITS interval (clipped to the query),
+                # never the whole query range: a fence recorded against foreign
+                # ranges survives slicing at stores it can never apply on,
+                # stranding their waiters forever.  Adjacent intervals with the
+                # same fence coalesce — per-interval fragments would otherwise
+                # balloon every deps set as the interval map refines
+                plo = phi = pfence = None
+                for lo, hi, e in self.map.items_over(rng.start, rng.end):
                     fence = e.fence() if e is not None else None
-                    if fence is not None:
-                        add(rng, fence)
+                    if fence is not None and fence == pfence and plo is not None \
+                            and phi == lo:
+                        phi = hi
+                        continue
+                    if pfence is not None and plo < phi:
+                        add(_Range(plo, phi), pfence)
+                    plo, phi, pfence = lo, hi, fence
+                if pfence is not None and plo < phi:
+                    add(_Range(plo, phi), pfence)
 
     def is_shard_redundant(self, txn_id: TxnId, participants) -> bool:
         """True iff ``txn_id`` is below the shard-applied bound at EVERY point

@@ -189,11 +189,12 @@ class Node(ConfigurationService.Listener):
         return do_barrier(self, seekables, epoch, barrier_type)
 
     def sync_point(self, seekables, exclusive: bool = False,
-                   blocking: bool = True) -> au.AsyncResult:
+                   blocking: bool = True, txn_id: Optional[TxnId] = None) -> au.AsyncResult:
         """Coordinate a sync point (CoordinateSyncPoint.java)."""
         from ..coordinate import sync_point as sp
         if exclusive:
-            return sp.coordinate_exclusive(self, seekables, blocking=blocking)
+            return sp.coordinate_exclusive(self, seekables, blocking=blocking,
+                                           txn_id=txn_id)
         return sp.coordinate_inclusive(self, seekables, blocking=blocking)
 
     def on_exclusive_sync_point_applied(self, txn_id: TxnId, ranges: Ranges) -> None:

@@ -280,7 +280,7 @@ class BeginRecovery(TxnRequest):
             else:
                 node.reply(from_node, reply_context, result)
 
-        node.map_reduce_consume_local(scope, txn_id.epoch, txn_id.epoch,
+        node.map_reduce_consume_local(scope, node.topology.min_epoch, txn_id.epoch,
                                       map_fn, reduce_fn).begin(consume)
 
     def __repr__(self):
@@ -342,7 +342,7 @@ class AcceptInvalidate(TxnRequest):
         txn_id, ballot = self.txn_id, self.ballot
 
         def map_fn(safe_store: SafeCommandStore):
-            outcome = C.accept_invalidate(safe_store, txn_id, ballot)
+            outcome = C.accept_invalidate(safe_store, txn_id, ballot, scope=self.scope)
             command = safe_store.get_if_exists(txn_id)
             if outcome is C.AcceptOutcome.REJECTED_BALLOT:
                 return InvalidateNack(command.promised)
@@ -369,7 +369,7 @@ class AcceptInvalidate(TxnRequest):
             else:
                 node.reply(from_node, reply_context, result)
 
-        node.map_reduce_consume_local(self.scope, txn_id.epoch, txn_id.epoch,
+        node.map_reduce_consume_local(self.scope, node.topology.min_epoch, txn_id.epoch,
                                       map_fn, reduce_fn).begin(consume)
 
     def __repr__(self):
@@ -387,9 +387,9 @@ class CommitInvalidate(TxnRequest):
         txn_id = self.txn_id
 
         def for_store(safe_store: SafeCommandStore):
-            C.commit_invalidate(safe_store, txn_id)
+            C.commit_invalidate(safe_store, txn_id, scope=self.scope)
 
-        node.for_each_local(self.scope, txn_id.epoch, txn_id.epoch, for_store)
+        node.for_each_local(self.scope, node.topology.min_epoch, txn_id.epoch, for_store)
 
     def __repr__(self):
         return f"CommitInvalidate({self.txn_id!r})"

@@ -67,10 +67,16 @@ class CheckStatusOk(Reply):
     def of(txn_id: TxnId, command, local_ranges=None) -> "CheckStatusOk":
         from ..primitives.keys import Ranges
         local = local_ranges if local_ranges is not None else Ranges.EMPTY
+        invalidated = command.save_status is SaveStatus.INVALIDATED
         stable_for = local if command.save_status.has_been(Status.STABLE) \
-            and not command.save_status.is_truncated else Ranges.EMPTY
-        applied_for = local if command.save_status.has_been(Status.PRE_APPLIED) \
-            and not command.save_status.is_truncated else Ranges.EMPTY
+            and not command.save_status.is_truncated and not invalidated \
+            else Ranges.EMPTY
+        # applied_for asserts "the CARRIED writes cover these ranges" — it is
+        # what gates outcome adoption at peers, so it must track the writes
+        # payload: TRUNCATE_WITH_OUTCOME keeps its writes and still serves the
+        # outcome; plain TRUNCATE/ERASE (writes dropped) claims nothing
+        applied_for = local if command.writes is not None and not invalidated \
+            and command.save_status.has_been(Status.PRE_APPLIED) else Ranges.EMPTY
         return CheckStatusOk(txn_id, command.save_status, command.promised,
                              command.accepted_or_committed, command.execute_at,
                              command.durability, command.route, command.partial_txn,
@@ -198,9 +204,28 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk) -> N
     def for_store(safe_store: SafeCommandStore) -> None:
         status = merged.save_status
         if status is SaveStatus.INVALIDATED:
-            C.commit_invalidate(safe_store, txn_id)
+            C.commit_invalidate(safe_store, txn_id, scope=route)
             return
         if status.is_truncated:
+            local_parts_t = route.participants().slice(safe_store.current_ranges())
+            # the cluster truncated this txn after it applied; a lagging local
+            # waiter would otherwise block forever (recovery nacks Truncated).
+            # If the merged view still CARRIES the outcome
+            # (TRUNCATE_WITH_OUTCOME), adopt it directly: writes land (the data
+            # store is timestamp-ordered and idempotent) and the command becomes
+            # a truncated tombstone (Propagate.java truncated handling / Infer)
+            command = safe_store.get_if_exists(txn_id)
+            if command is None or command.save_status.has_been(Status.PRE_APPLIED):
+                return
+            if merged.execute_at is None:
+                return
+            writes_free = not txn_id.is_write   # sync points / reads: applying
+            if writes_free or (merged.writes is not None                # is a no-op
+                               and merged.applied_for.contains_all(local_parts_t)):
+                C.adopt_truncated_outcome(safe_store, command, route,
+                                          merged.execute_at,
+                                          None if writes_free else merged.writes,
+                                          merged.result)
             return
         # gate each tier on the merged knowledge actually covering THIS store's
         # slice of the route (the reference's Known.sufficientFor per-store gate,

@@ -261,6 +261,11 @@ class PreAccept(TxnRequest):
             if outcome in (C.AcceptOutcome.REJECTED_BALLOT, C.AcceptOutcome.TRUNCATED):
                 return None
             command = safe_store.get_if_exists(txn_id)
+            if command.save_status is SaveStatus.INVALIDATED \
+                    or command.execute_at is None:
+                # invalidated (or otherwise undecidable) — an Ok here could
+                # feed a fast-path decision for a txn that can never commit
+                return None
             deps = calculate_partial_deps(safe_store, txn_id, partial_txn.keys,
                                           txn_id.as_timestamp())
             return (command.execute_at, deps)
@@ -279,7 +284,7 @@ class PreAccept(TxnRequest):
                 witnessed_at, deps = result
                 node.reply(from_node, reply_context, PreAcceptOk(txn_id, witnessed_at, deps))
 
-        node.map_reduce_consume_local(scope, txn_id.epoch, self.max_epoch,
+        node.map_reduce_consume_local(scope, node.topology.min_epoch, self.max_epoch,
                                       map_fn, reduce_fn).begin(consume)
 
     def __repr__(self):
@@ -319,6 +324,14 @@ class Accept(TxnRequest):
                 return ("nack", command.promised)
             if outcome is C.AcceptOutcome.TRUNCATED:
                 return ("nack", Ballot.MAX)
+            if outcome is C.AcceptOutcome.REDUNDANT:
+                # already (pre)committed — possibly at a DIFFERENT executeAt by a
+                # recovery coordinator — or invalidated: acking would let the
+                # proposer commit a second, conflicting decision (split brain).
+                # Reply Redundant→nack so the proposer fails Preempted and the
+                # true outcome is learned via CheckStatus
+                # (Accept.java:102, Propose.java:104-107)
+                return ("nack", Ballot.MAX)
             # collect deps newly witnessed up to executeAt (Accept.java:84-118)
             deps = calculate_partial_deps(safe_store, txn_id, keys, execute_at)
             return ("ok", deps)
@@ -341,7 +354,7 @@ class Accept(TxnRequest):
             else:
                 node.reply(from_node, reply_context, AcceptOk(txn_id, result[1]))
 
-        node.map_reduce_consume_local(scope, min(txn_id.epoch, execute_at.epoch),
+        node.map_reduce_consume_local(scope, node.topology.min_epoch,
                                       execute_at.epoch, map_fn, reduce_fn).begin(consume)
 
     def __repr__(self):
@@ -396,7 +409,8 @@ class Commit(TxnRequest):
             else:
                 node.reply(from_node, reply_context, COMMIT_OK)
 
-        node.map_reduce_consume_local(self.scope, txn_id.epoch, self.execute_at.epoch,
+        node.map_reduce_consume_local(self.scope, node.topology.min_epoch,
+                                      self.execute_at.epoch,
                                       map_fn, worst_outcome).begin(consume)
 
     def __repr__(self):
@@ -456,6 +470,9 @@ def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
         if any(d == "nack" for d in datas):
             node.reply(from_node, reply_context, ReadNack("invalidated"))
             return
+        if any(d == "obsolete" for d in datas):
+            node.reply(from_node, reply_context, ReadNack("obsolete"))
+            return
         if any(d == "unavailable" for d in datas):
             node.reply(from_node, reply_context, ReadNack("unavailable"))
             return
@@ -478,8 +495,18 @@ def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncCha
         if command.save_status is SaveStatus.INVALIDATED:
             result.set_success("nack")
             return True
-        if command.save_status.ordinal >= SaveStatus.READY_TO_EXECUTE.ordinal \
-                and not command.save_status.is_truncated:
+        if command.save_status.ordinal > SaveStatus.READY_TO_EXECUTE.ordinal \
+                or command.save_status.is_truncated:
+            # the command raced past ReadyToExecute here (an Apply — possibly a
+            # recovery's Maximal — or truncation won): the executeAt snapshot
+            # can no longer be served from this replica, and crucially its
+            # dependencies may NOT all be locally applied yet (PreApplied means
+            # waiting-to-apply).  Reading now would return torn state; report
+            # obsolete so the coordinator reads elsewhere
+            # (ReadData.java:57-260 State/Action obsolescence machine)
+            result.set_success("obsolete")
+            return True
+        if command.save_status is SaveStatus.READY_TO_EXECUTE:
             # bootstrap in progress: the data for these ranges is incomplete
             # here — refuse so the coordinator reads another replica
             # (ReadData unavailable semantics)
@@ -552,13 +579,62 @@ class Apply(TxnRequest):
             elif result is C.CommitOutcome.INSUFFICIENT:
                 node.reply(from_node, reply_context, ReadNack("insufficient"))
             else:
+                # Apply acks once the outcome is durably RECORDED (Apply.java
+                # ApplyReply.Applied); callers needing execution completion use
+                # WaitUntilApplied / ApplyThenWaitUntilApplied instead
                 node.reply(from_node, reply_context, APPLY_OK)
 
-        node.map_reduce_consume_local(self.scope, txn_id.epoch, self.execute_at.epoch,
+        node.map_reduce_consume_local(self.scope, node.topology.min_epoch,
+                                      self.execute_at.epoch,
                                       map_fn, worst_outcome).begin(consume)
 
     def __repr__(self):
         return f"Apply[{self.kind}]({self.txn_id!r})"
+
+
+class ApplyThenWaitUntilApplied(Apply):
+    """Apply (Maximal) and reply only once the txn has actually APPLIED in every
+    intersecting local store — the blocking-sync-point execution message
+    (ApplyThenWaitUntilApplied.java; ExecuteSyncPoint.ExecuteBlocking sends it
+    so its quorum means "executed", not merely "recorded")."""
+
+    __slots__ = ()
+
+    @property
+    def type(self):
+        return MessageType.APPLY_THEN_WAIT_UNTIL_APPLIED_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id, scope, execute_at = self.txn_id, self.scope, self.execute_at
+
+        def map_fn(safe_store: SafeCommandStore):
+            return C.apply_(safe_store, txn_id, self.route, execute_at,
+                            self.partial_deps, self.partial_txn, self.writes,
+                            self.result)
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
+            elif result is C.CommitOutcome.INSUFFICIENT:
+                node.reply(from_node, reply_context, ReadNack("insufficient"))
+            else:
+                def done(outcome, f2):
+                    if f2 is not None:
+                        node.message_sink.reply_with_unknown_failure(
+                            from_node, reply_context, f2)
+                    elif outcome == "nack":
+                        node.reply(from_node, reply_context, ReadNack("invalidated"))
+                    else:
+                        node.reply(from_node, reply_context, APPLY_OK)
+                await_applied_local(node, txn_id, scope, txn_id.epoch,
+                                    execute_at.epoch).begin(done)
+
+        node.map_reduce_consume_local(self.scope, node.topology.min_epoch,
+                                      self.execute_at.epoch,
+                                      map_fn, worst_outcome).begin(consume)
+
+    def __repr__(self):
+        return f"ApplyThenWaitUntilApplied({self.txn_id!r})"
 
 
 # ---------------------------------------------------------------------------

@@ -250,7 +250,7 @@ class RangeDeps:
     here by sorted-start linear probing (correct; the TPU overlap-join kernel in
     ``ops`` is the fast path for batched queries)."""
 
-    __slots__ = ("ranges", "txn_ids", "offsets", "indices")
+    __slots__ = ("ranges", "txn_ids", "offsets", "indices", "_by_txn")
 
     def __init__(self, ranges: Tuple[Range, ...], txn_ids: Tuple[TxnId, ...],
                  offsets: np.ndarray, indices: np.ndarray):
@@ -258,6 +258,7 @@ class RangeDeps:
         self.txn_ids = txn_ids
         self.offsets = offsets
         self.indices = indices
+        self._by_txn = None         # lazy inversion (participants)
 
     NONE: "RangeDeps"
 
@@ -310,12 +311,15 @@ class RangeDeps:
         ti = bisect_left(self.txn_ids, txn_id)
         if ti >= len(self.txn_ids) or self.txn_ids[ti] != txn_id:
             return Ranges.EMPTY
-        out = []
-        for ri, r in enumerate(self.ranges):
-            seg = self.indices[int(self.offsets[ri]):int(self.offsets[ri + 1])]
-            if any(int(i) == ti for i in seg):
-                out.append(r)
-        return Ranges.of(*out)
+        if self._by_txn is None:
+            # one-pass lazy inversion (KeyDeps.invert semantics): per-call
+            # linear scans are quadratic across a WaitingOn initialise
+            m: Dict[int, List[Range]] = {}
+            for ri, r in enumerate(self.ranges):
+                for i in self.indices[int(self.offsets[ri]):int(self.offsets[ri + 1])]:
+                    m.setdefault(int(i), []).append(r)
+            self._by_txn = {i: Ranges.of(*rs) for i, rs in m.items()}
+        return self._by_txn.get(ti, Ranges.EMPTY)
 
     # -- algebra ------------------------------------------------------------
     def slice(self, covering: Ranges) -> "RangeDeps":

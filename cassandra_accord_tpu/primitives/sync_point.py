@@ -13,12 +13,16 @@ from .timestamp import TxnId
 
 
 class SyncPoint:
-    __slots__ = ("txn_id", "route", "deps")
+    __slots__ = ("txn_id", "route", "deps", "execute_at")
 
-    def __init__(self, txn_id: TxnId, route: Route, deps: Deps):
+    def __init__(self, txn_id: TxnId, route: Route, deps: Deps, execute_at=None):
         self.txn_id = txn_id
         self.route = route
         self.deps = deps
+        # agreed executeAt (may exceed txn_id on the slow path): consumers that
+        # re-disseminate the fence (fetch streaming) must use THIS
+        self.execute_at = execute_at if execute_at is not None \
+            else txn_id.as_timestamp()
 
     @property
     def keys_or_ranges(self):

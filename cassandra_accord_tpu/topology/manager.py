@@ -168,12 +168,32 @@ class TopologyManager:
                            for e in range(min_epoch, max_epoch + 1)])
 
     def with_unsynced_epochs(self, unseekables, min_epoch: int, max_epoch: int) -> Topologies:
-        """Like precise_epochs but extended down over epochs that are not yet
-        sync-complete, so coordination witnesses any in-flight prior-epoch txns."""
+        """Like precise_epochs but extended down over epochs that are not both
+        sync-complete AND CLOSED over the footprint.  Sync alone is not enough:
+        an epoch may be synced while old-epoch transactions are still in flight
+        on its replicas — a dependency round that skips them can miss a
+        committed-at-old-executeAt txn entirely (the bootstrap-fence
+        completeness hole).  Only an applied exclusive sync point closes an
+        epoch's ranges to new proposals (TopologyManager epoch closure,
+        TopologyManager.java:78-795)."""
         lo = min_epoch
-        while lo > self._min_epoch and not self.is_sync_complete(lo - 1):
+        while lo > self._min_epoch and not (
+                self.is_sync_complete(lo - 1)
+                and self._closed_over(lo - 1, unseekables)):
             lo -= 1
         return self.precise_epochs(unseekables, lo, max_epoch)
+
+    def _closed_over(self, epoch: int, unseekables) -> bool:
+        """Is every part of ``unseekables`` marked closed at ``epoch``?"""
+        if not self.has_epoch(epoch):
+            return True
+        st = self._epochs[epoch - self._min_epoch]
+        from ..primitives.route import Route
+        parts = unseekables.participants() if isinstance(unseekables, Route) \
+            else unseekables
+        if parts is None:
+            return False
+        return st.closed.contains_all(parts)
 
     def with_open_epochs(self, unseekables, min_epoch: int, max_epoch: int) -> Topologies:
         return self.with_unsynced_epochs(unseekables, min_epoch, max_epoch)

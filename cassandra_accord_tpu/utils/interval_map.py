@@ -76,6 +76,24 @@ class ReducingIntervalMap(Generic[V]):
             i += 1
         return out
 
+    def items_over(self, start, end) -> List[Tuple[Any, Any, Optional[V]]]:
+        """(lo, hi, value) per map interval intersecting [start, end), clipped
+        to the query bounds — callers that attribute a value to "its" interval
+        must use THIS, not values_over, or they smear the value across the whole
+        query range."""
+        out: List[Tuple[Any, Any, Optional[V]]] = []
+        i = bisect_right(self.bounds, start)
+        lo = start
+        while True:
+            hi = self.bounds[i] if i < len(self.bounds) else None
+            seg_end = end if hi is None or hi > end else hi
+            out.append((lo, seg_end, self.values[i]))
+            if hi is None or hi >= end:
+                break
+            lo = hi
+            i += 1
+        return out
+
     def is_empty(self) -> bool:
         return all(v is None for v in self.values)
 

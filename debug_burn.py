@@ -1,0 +1,52 @@
+"""Ad-hoc chaos-burn debugger: trace messages about specific ops."""
+import sys
+
+from cassandra_accord_tpu.harness.burn import run_burn, SimulationException
+
+SEED = int(sys.argv[1]) if len(sys.argv) > 1 else 4
+OPS = int(sys.argv[2]) if len(sys.argv) > 2 else 60
+WATCH_OPS = [int(x) for x in sys.argv[3].split(",")] if len(sys.argv) > 3 else [10, 25]
+
+op_txn = {}          # op_id -> txn_id
+txn_op = {}          # txn_id -> op_id
+events = []
+
+def on_submit(op_id, txn_id, txn, coord):
+    op_txn[op_id] = txn_id
+    txn_op[txn_id] = op_id
+    events.append((None, f"SUBMIT op{op_id} {txn_id} kind={txn.kind.name} "
+                   f"keys={txn.keys} coord=n{coord}"))
+
+def tracer(event, frm, to, msg_id, message, now):
+    tid = getattr(message, "txn_id", None)
+    if tid is None or tid not in txn_op:
+        return
+    op = txn_op[tid]
+    if op not in WATCH_OPS:
+        return
+    desc = f"{type(message).__name__}"
+    for attr in ("deps", "partial_deps"):
+        d = getattr(message, attr, None)
+        if d is not None:
+            try:
+                ids = sorted({txn_op.get(t, t) for t in d.txn_ids()})
+                desc += f" deps={ids}"
+            except Exception:
+                pass
+    ss = getattr(message, "save_status", None)
+    if ss is not None:
+        desc += f" ss={ss.name}"
+    ea = getattr(message, "execute_at", None)
+    if ea is not None:
+        desc += f" ea={ea}"
+    events.append((now, f"{now/1e6:9.3f} {event:18s} n{frm}->n{to} #{msg_id} op{op} {desc}"))
+
+try:
+    r = run_burn(SEED, ops=OPS, concurrency=10, chaos=True, allow_failures=True,
+                 tracer=tracer, on_submit=on_submit)
+    print("OK", r)
+except SimulationException as e:
+    print("FAIL", str(e.cause)[:200])
+print(f"--- {len(events)} events for ops {WATCH_OPS} ---")
+for _, line in events:
+    print(line)

@@ -91,13 +91,19 @@ def test_topology_manager_epochs_and_sync():
     tm.on_remote_sync_complete(3, 2)
     assert tm.is_sync_complete(2)
 
-    # unsynced extension: while epoch 2 unsynced, coordination at epoch 3 reaches back
+    # open-epoch extension: coordination reaches back over epochs that are not
+    # yet both synced AND closed — sync alone leaves in-flight old-epoch txns
+    # invisible to deps rounds (exclusive sync points close epochs)
     t3 = Topology(3, [Shard(r(0, 10), [2, 3, 4])])
     tm.on_topology_update(t3)
-    assert tm.with_unsynced_epochs(None, 3, 3).size() == 1  # 2 is synced now
+    assert tm.with_unsynced_epochs(None, 3, 3).size() == 3  # 1,2 synced, NOT closed
+    tm.on_epoch_closed(Ranges.of(r(0, 10)), 1)
+    tm.on_epoch_closed(Ranges.of(r(0, 10)), 2)
+    assert tm.with_unsynced_epochs(Ranges.of(r(0, 10)), 3, 3).size() == 1
     t4 = Topology(4, [Shard(r(0, 10), [2, 3, 4])])
     tm.on_topology_update(t4)
-    assert tm.with_unsynced_epochs(None, 4, 4).size() == 2  # 3 not synced -> include
+    # 3 neither synced nor closed -> include
+    assert tm.with_unsynced_epochs(Ranges.of(r(0, 10)), 4, 4).size() == 2
 
 
 def test_topology_manager_await_and_pending_sync():
