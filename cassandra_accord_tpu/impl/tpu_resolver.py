@@ -1,26 +1,48 @@
-"""TpuDepsResolver — the device-resident conflict-index data plane.
+"""TpuDepsResolver — the accelerator conflict-index data plane.
 
 The per-store conflict index (the reference's CommandsForKey sorted arrays +
-MaxConflicts map, cfk/CommandsForKey.java:615-628, MaxConflicts.java:32) lives
-on-device as an ``ops.graph_state.GraphState``: a key-incidence matrix, packed
-timestamp lanes, kind/status codes and an active mask over fixed txn slots.
+MaxConflicts map, cfk/CommandsForKey.java:615-628, MaxConflicts.java:32) is a
+fixed-capacity ARRAY index — a key-incidence matrix, packed timestamp lanes,
+kind/status codes and an active mask over txn slots — instead of the
+reference's per-key pointer-chased sorted arrays.
 
 Every dependency query (``SafeCommandStore.map_reduce_active`` →
 ``calculate_partial_deps``, PreAccept.java:245-267) and timestamp-proposal
-consult (``max_conflict``) is answered by a batched MXU join
-(ops.deps_kernels.overlap_join / max_conflict_keys) instead of the reference's
-scalar per-key scans (cfk/CommandsForKey.java:925-1000).
+consult (``max_conflict``) is ONE fused join over that index
+(ops.deps_kernels.consult): key-overlap matmul × started-before lex compare ×
+kind-witness mask, plus the masked lexicographic max for the timestamp
+proposal — not the reference's scalar per-key scans
+(cfk/CommandsForKey.java:925-1000).
 
-Host/device split:
-- the host keeps O(1)-per-txn bookkeeping: TxnId ↔ slot maps, per-txn key
-  sets (for result attribution), status/executeAt mirrors (for monotonic
-  update rules and capacity-growth rebuilds);
-- the device holds the O(T×K) index and does all O(T) scan work.
+Two execution tiers answer the SAME join bit-identically, picked per call by
+a cost model (the accelerator-native split: dispatch to the MXU only when the
+work amortizes launch+transfer):
 
-Mutations (register / prune) are buffered host-side and flushed to the device
-as batched scatters immediately before the next query, so a burst of
-concurrent PreAccepts between queries becomes one fused device update — the
-batching the dense per-txn Java scan cannot do.
+- host tier  — the join as one vectorized numpy pass over the index arrays
+               (BLAS f32 matmul + lane-wise lex compares).  No launch
+               overhead; serves small windows.
+- device tier — ops.deps_kernels.consult on the TPU: bf16 MXU matmul over
+               [B, K] × [K, T].  Serves large batches / deep indexes, where
+               it is 30-80× the host tier (bench.py kernel_scaling).
+
+The canonical index lives in host numpy (mutations are in-place row writes);
+the device copy is synced lazily when the device tier is chosen.  The cost
+model self-calibrates: it measures one launch round-trip and the host tier's
+element throughput, then dispatches by B·T·K.  Tier choice never affects
+answers (both tiers are parity-checked against the cfk walk by
+VerifyDepsResolver), only speed.
+
+Queries batch across messages: a coalesced delivery window
+(harness/cluster.py ``batch_window_us``) declares its upcoming
+PreAccept/Accept consults via ``prefetch``, which answers ALL of them in one
+fused consult (one numpy pass or one MXU launch).  Live queries are then
+served from the cached answers with EXACT sequential semantics: every index
+mutation since the prefetch marks its keys dirty, and a cached answer is only
+used when no dirty key intersects the query — except the querying txn's own
+registration, which provably cannot change its own answer (the deps walk
+excludes ``by`` host-side, and the timestamp consult runs before the
+self-registration).  Anything else falls back to an individual consult, so
+batching is a pure fast path.
 
 Slot lifecycle: slots are recycled once a txn is fully pruned from every key
 it touched (the cfk prune protocol driven by RedundantBefore GC,
@@ -30,6 +52,8 @@ when the free list empties.
 from __future__ import annotations
 
 import heapq
+import os
+import time
 from typing import Dict, List, Optional, Set, Tuple, TYPE_CHECKING
 
 import numpy as np
@@ -43,6 +67,31 @@ if TYPE_CHECKING:
     from ..local.command_store import CommandStore
     from ..local.cfk import InternalStatus
 
+TS_LANES = 5
+
+_INVALIDATED: Optional[int] = None
+
+
+def _invalidated_code() -> int:
+    """InternalStatus.INVALIDATED, resolved lazily from the one source of
+    truth (local.cfk) so the host tier's eligibility mask can never diverge
+    from the cfk walk or the device kernel."""
+    global _INVALIDATED
+    if _INVALIDATED is None:
+        from ..local.cfk import InternalStatus
+        _INVALIDATED = int(InternalStatus.INVALIDATED)
+    return _INVALIDATED
+
+_WITNESSES: Optional[np.ndarray] = None
+
+
+def _witnesses() -> np.ndarray:
+    global _WITNESSES
+    if _WITNESSES is None:
+        from ..ops.deps_kernels import _witness_table
+        _WITNESSES = _witness_table()
+    return _WITNESSES
+
 
 def _pack_before(before: Timestamp) -> Tuple[int, int, int, int, int]:
     """Pack a query bound, saturating out-of-device-range bounds (e.g. the
@@ -53,6 +102,15 @@ def _pack_before(before: Timestamp) -> Tuple[int, int, int, int, int]:
     except Exception:  # noqa: BLE001 — bound exceeds device packing range
         m = 0x7FFFFFFF
         return (m, m, m, m, m)
+
+
+def _lex_less(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Lexicographic a < b over packed lanes [..., 5] (numpy; mirrors
+    ops.graph_state.ts_less exactly)."""
+    lt = a[..., TS_LANES - 1] < b[..., TS_LANES - 1]
+    for lane in range(TS_LANES - 2, -1, -1):
+        lt = (a[..., lane] < b[..., lane]) | ((a[..., lane] == b[..., lane]) & lt)
+    return lt
 
 
 class _TxnMirror:
@@ -69,8 +127,14 @@ class _TxnMirror:
 
 
 class TpuDepsResolver(DepsResolver):
-    def __init__(self, store: "CommandStore", txn_capacity: int = 64,
-                 key_capacity: int = 64):
+    def __init__(self, store: "CommandStore", txn_capacity: Optional[int] = None,
+                 key_capacity: Optional[int] = None):
+        # initial capacities: growth doubles them (a host rebuild + a new jit
+        # shape each time), so long-running/bench deployments start big
+        if txn_capacity is None:
+            txn_capacity = int(os.environ.get("ACCORD_TPU_TXN_SLOTS", "64"))
+        if key_capacity is None:
+            key_capacity = int(os.environ.get("ACCORD_TPU_KEY_SLOTS", "64"))
         self.store = store
         self.txns: Dict[TxnId, _TxnMirror] = {}
         self.txn_at: Dict[int, TxnId] = {}          # slot -> txn (attribution)
@@ -84,9 +148,24 @@ class TpuDepsResolver(DepsResolver):
         self._dirty_txns: Set[TxnId] = set()
         self._clear_bits: List[Tuple[int, int]] = []
         self._deactivate: List[int] = []
-        self._state = None          # lazy: GraphState built on first flush
         self._t = txn_capacity
         self._k = key_capacity
+        self._h: Optional[dict] = None   # canonical numpy index (lazy)
+        self._device = None              # device copy (lazy, synced on use)
+        self._device_clean = False
+        # tier selection: 'auto' cost model, or forced for tests/benches
+        self.tier = os.environ.get("ACCORD_TPU_TIER", "auto")
+        self._threshold_elems: Optional[float] = None
+        self.host_consults = 0
+        self.device_consults = 0
+        # prefetched-answer cache for the current delivery window (None = no
+        # window active): sig -> answer, plus keys dirtied since the prefetch
+        self._cache: Optional[Dict[tuple, object]] = None
+        self._cache_dirty: Dict[RoutingKey, Set[TxnId]] = {}
+        self._prefetch_preexisting: Set[TxnId] = set()
+        self.prefetch_hits = 0
+        self.prefetch_patched = 0
+        self.prefetch_misses = 0
 
     # -- registration (cfk.update semantics) ---------------------------------
     def register(self, txn_id: TxnId, status, execute_at, keys) -> None:
@@ -108,6 +187,7 @@ class TpuDepsResolver(DepsResolver):
             elif status_i == m.status and execute_at is not None \
                     and status_i == int(IS.ACCEPTED):
                 m.execute_at = execute_at
+        added_key = False
         for rk in keys:
             if rk not in m.keys:
                 # allocate the key slot BEFORE recording the incidence: growth
@@ -115,10 +195,23 @@ class TpuDepsResolver(DepsResolver):
                 if rk not in self.key_slot:
                     self.key_slot[rk] = self._alloc_key_slot()
                 m.keys.add(rk)
+                added_key = True
                 self.key_refs[rk] = self.key_refs.get(rk, 0) + 1
         self._dirty_txns.add(txn_id)
+        if self._cache is not None and added_key \
+                and txn_id in self._prefetch_preexisting:
+            # a PRE-EXISTING txn grew its footprint mid-window: its base
+            # contributions in cached answers are un-patchable — drop the cache
+            # (rare: routes only widen on cross-epoch re-contact)
+            self._cache = None
+        if self._cache is not None:
+            # conservatively dirty the txn's WHOLE footprint: a status/executeAt
+            # upgrade changes its contribution on every key it touches
+            for rk in m.keys:
+                self._cache_dirty.setdefault(rk, set()).add(txn_id)
 
     def on_pruned(self, key: RoutingKey, txn_ids) -> None:
+        self._cache = None   # prunes mid-window are rare: drop the whole cache
         ks = self.key_slot.get(key)
         if ks is None:
             return
@@ -139,7 +232,7 @@ class TpuDepsResolver(DepsResolver):
 
     def _release_key(self, key: RoutingKey) -> None:
         """Drop a live incidence; recycle the key slot when none remain (the
-        device column is already zeroed by the per-incidence clears)."""
+        index column is already zeroed by the per-incidence clears)."""
         n = self.key_refs.get(key, 0) - 1
         if n > 0:
             self.key_refs[key] = n
@@ -149,54 +242,262 @@ class TpuDepsResolver(DepsResolver):
             if ks is not None:
                 heapq.heappush(self.free_key_slots, ks)
 
+    # -- batched prefetch (delivery-window coalescing) ------------------------
+    def prefetch(self, specs) -> None:
+        """Answer every declared query in ONE fused consult and cache the
+        answers for the window (see module doc for the exactness rule)."""
+        self._cache = {}
+        self._cache_dirty = {}
+        # ids indexed as of the prefetch: mutations by NEW txns can be patched
+        # into cached answers exactly; upgrades of these force a fallback
+        self._prefetch_preexisting = set(self.txns)
+        live: List[Tuple[tuple, str, List[RoutingKey], object]] = []
+        for spec in specs:
+            known = [rk for rk in spec.keys if rk in self.key_slot]
+            if spec.op == "kc":
+                sig = ("kc", spec.by, frozenset(known), spec.before)
+                if not known or not self.txns:
+                    self._cache[sig] = []
+                    continue
+            else:
+                sig = ("mc", frozenset(known))
+                if not known or not self.txns:
+                    self._cache[sig] = None
+                    continue
+            live.append((sig, spec.op, known,
+                         spec.before if spec.op == "kc" else None))
+        if not live:
+            return
+        b = len(live)
+        q = np.zeros((b, self._k), dtype=np.int8)
+        before_lanes = np.zeros((b, TS_LANES), dtype=np.int32)
+        kind = np.zeros((b,), dtype=np.int8)
+        for i, (sig, op, known, before) in enumerate(live):
+            for rk in known:
+                q[i, self.key_slot[rk]] = 1
+            if op == "kc":
+                before_lanes[i] = _pack_before(before)
+                kind[i] = int(sig[1].kind)
+        deps, max_lanes = self._consult(q, before_lanes, kind)
+        for i, (sig, op, known, _before) in enumerate(live):
+            if op == "kc":
+                self._cache[sig] = self._attribute(deps[i], set(known))
+            else:
+                ts = Timestamp.unpack_lanes(tuple(int(v) for v in max_lanes[i]))
+                self._cache[sig] = None if ts == Timestamp.NONE else ts
+
+    def end_batch(self) -> None:
+        self._cache = None
+        self._cache_dirty = {}
+
+    def _cached(self, sig, known, exempt: Optional[TxnId]):
+        """A cached answer, made exact against mutations since the prefetch:
+
+        - keys dirtied only by ``exempt`` (the querying txn itself — excluded
+          from its own deps answer host-side) need nothing;
+        - keys dirtied by txns NEW since the prefetch are patched with those
+          txns' exact contributions from the (always-current) host mirrors —
+          at call time the mirrors ARE the sequential state, so the patched
+          answer equals a live query's;
+        - keys dirtied by an UPGRADE of a pre-existing txn force a fallback
+          (its base contribution is already folded in and cannot be unpicked).
+
+        Returns (hit, answer, delta_ids) — delta_ids the new txns to patch in
+        (empty on clean hits); (False, None, None) on miss/fallback."""
+        if self._cache is None:
+            return False, None, None
+        if sig not in self._cache:
+            self.prefetch_misses += 1
+            return False, None, None
+        delta_ids: Set[TxnId] = set()
+        dirty = self._cache_dirty
+        if dirty:
+            pre = self._prefetch_preexisting
+            for rk in known:
+                for d in dirty.get(rk, ()):
+                    if d == exempt and d in pre:
+                        # upgrade of the querying txn itself: kc-invariant
+                        # (txn_id/kind static, stays eligible; key additions
+                        # to pre-existing txns nuke the cache in register)
+                        continue
+                    if d in pre or d not in self.txns:
+                        self.prefetch_misses += 1
+                        return False, None, None
+                    # NEW txns — including the querying txn itself, which the
+                    # CPU oracle's cfk walk also reports when txn_id < before
+                    # (the Accept deps walk at before=executeAt) — are patched
+                    # from the mirrors under the exact same predicates
+                    delta_ids.add(d)
+        if delta_ids:
+            self.prefetch_patched += 1
+        else:
+            self.prefetch_hits += 1
+        return True, self._cache[sig], delta_ids
+
     # -- queries -------------------------------------------------------------
     def key_conflicts(self, by: TxnId, keys, before: Timestamp):
-        import jax.numpy as jnp
-        from ..ops import deps_kernels as dk
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
             return []
-        self._flush()
+        hit, ans, delta = self._cached(("kc", by, frozenset(known), before),
+                                       known, by)
+        if hit:
+            out = list(ans)
+            if delta:
+                known_set = set(known)
+                wit = by.kind.witnesses
+                from ..local.cfk import InternalStatus as IS
+                inval = int(IS.INVALIDATED)
+                for d in sorted(delta):
+                    m = self.txns[d]
+                    if m.status == inval or not wit(d.kind) \
+                            or not d.as_timestamp() < before:
+                        continue
+                    for rk in m.keys & known_set:
+                        out.append((rk, d))
+            return out
         q = np.zeros((1, self._k), dtype=np.int8)
         for rk in known:
             q[0, self.key_slot[rk]] = 1
         before_lanes = np.asarray([_pack_before(before)], dtype=np.int32)
         kind = np.asarray([int(by.kind)], dtype=np.int8)
-        s = self._state
-        mask = np.asarray(dk.overlap_join(
-            s.key_inc, s.txn_id, s.kind, s.status, s.active,
-            jnp.asarray(q), jnp.asarray(before_lanes), jnp.asarray(kind)))[0]
-        return self._attribute(mask, set(known))
+        deps, _ = self._consult(q, before_lanes, kind, want_max=False)
+        return self._attribute(deps[0], set(known))
 
     def range_conflicts(self, by: TxnId, rng: Range, before: Timestamp):
         keys = [rk for rk in self.key_slot if rng.contains(rk)]
         return self.key_conflicts(by, keys, before)
 
     def max_conflict_keys(self, keys) -> Optional[Timestamp]:
-        import jax.numpy as jnp
-        from ..ops import deps_kernels as dk
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
             return None
-        self._flush()
+        hit, ans, delta = self._cached(("mc", frozenset(known)), known, None)
+        if hit:
+            if delta:
+                known_set = set(known)
+                for d in delta:
+                    m = self.txns[d]
+                    if m.keys & known_set:
+                        c = m.execute_at if not m.execute_at < d.as_timestamp() \
+                            else d.as_timestamp()
+                        if ans is None or ans < c:
+                            ans = c
+            return ans
         q = np.zeros((1, self._k), dtype=np.int8)
         for rk in known:
             q[0, self.key_slot[rk]] = 1
-        s = self._state
-        lanes = np.asarray(dk.max_conflict_keys(
-            s.key_inc, s.ts, s.txn_id, s.active, jnp.asarray(q)))[0]
-        ts = Timestamp.unpack_lanes(tuple(int(v) for v in lanes))
+        _, lanes = self._consult(q, np.zeros((1, TS_LANES), dtype=np.int32),
+                                 np.zeros((1,), dtype=np.int8), want_deps=False)
+        ts = Timestamp.unpack_lanes(tuple(int(v) for v in lanes[0]))
         return None if ts == Timestamp.NONE else ts
 
     def max_conflict_range(self, rng: Range) -> Optional[Timestamp]:
         keys = [rk for rk in self.key_slot if rng.contains(rk)]
         return self.max_conflict_keys(keys)
 
-    # -- device state management ---------------------------------------------
+    # -- the fused consult: tier dispatch ------------------------------------
+    def _consult(self, q: np.ndarray, before: np.ndarray, kind: np.ndarray,
+                 want_deps: bool = True, want_max: bool = True):
+        """Answer a [B]-query batch: (deps [B, T] bool, max_lanes [B, 5]).
+        Host and device tiers compute the identical join; the cost model picks
+        by B·T·K vs the calibrated launch-amortization threshold."""
+        self._flush()
+        b = q.shape[0]
+        if self.tier == "device" or (
+                self.tier == "auto"
+                and b * self._t * self._k >= self._device_threshold()):
+            return self._consult_device(q, before, kind)
+        return self._consult_host(q, before, kind, want_deps, want_max)
+
+    def _device_threshold(self) -> float:
+        """elems = B·T·K above which the device tier wins: calibrated once
+        from a measured launch round-trip and the host tier's element rate."""
+        if self._threshold_elems is None:
+            env = os.environ.get("ACCORD_TPU_DISPATCH_ELEMS")
+            if env is not None:
+                self._threshold_elems = float(env)
+            else:
+                self._threshold_elems = _calibrate_threshold()
+        return self._threshold_elems
+
+    def _consult_host(self, q, before, kind, want_deps=True, want_max=True):
+        """The join as one vectorized numpy pass (BLAS f32 matmul — exact for
+        0/1 values — + lane-wise lex compares).  Mirrors ops.deps_kernels.
+        consult bit-for-bit."""
+        self.host_consults += 1
+        h = self._h
+        share = (q.astype(np.float32) @ h["key_inc_f32"]) > 0.0          # [B,T]
+        deps = None
+        if want_deps:
+            started = _lex_less(h["txn_id"][None, :, :], before[:, None, :])
+            wit = _witnesses()[kind[:, None].astype(np.int64),
+                               h["kind"][None, :].astype(np.int64)]
+            eligible = h["active"] & (h["status"] != _invalidated_code())
+            deps = share & started & wit & eligible[None, :]
+        max_lanes = None
+        if want_max:
+            mc_mask = share & h["active"][None, :]
+            per_slot = np.where(_lex_less(h["ts"], h["txn_id"])[:, None],
+                                h["txn_id"], h["ts"])                    # [T,5]
+            b = q.shape[0]
+            tie = mc_mask
+            max_lanes = np.zeros((b, TS_LANES), dtype=np.int64)
+            for lane in range(TS_LANES):
+                vals = np.where(tie, per_slot[None, :, lane], -1)
+                best = vals.max(axis=1) if vals.shape[1] else \
+                    np.full((b,), -1, dtype=np.int64)
+                tie = tie & (per_slot[None, :, lane] == best[:, None])
+                max_lanes[:, lane] = np.maximum(best, 0)
+        return deps, max_lanes
+
+    def _consult_device(self, q, before, kind):
+        """ops.deps_kernels.consult on the TPU — one fused MXU launch for the
+        whole batch.  The batch dim pads to a power of two so jit compiles
+        once per shape bucket, not once per window size."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops import deps_kernels as dk
+        self.device_consults += 1
+        self._sync_device()
+        b = q.shape[0]
+        b_pad = 1 << max(0, b - 1).bit_length()
+        if b_pad != b:
+            q = np.concatenate(
+                [q, np.zeros((b_pad - b, q.shape[1]), dtype=q.dtype)])
+            before = np.concatenate(
+                [before, np.zeros((b_pad - b, TS_LANES), dtype=before.dtype)])
+            kind = np.concatenate(
+                [kind, np.zeros((b_pad - b,), dtype=kind.dtype)])
+        s = self._device
+        deps, max_lanes = jax.device_get(dk.consult(
+            s["key_inc"], s["ts"], s["txn_id"], s["kind"], s["status"],
+            s["active"], jnp.asarray(q), jnp.asarray(before),
+            jnp.asarray(kind)))
+        return deps[:b], max_lanes[:b]
+
+    def _sync_device(self) -> None:
+        """Upload the canonical host index to the device if stale (lazy: only
+        the device tier pays for device residency)."""
+        if self._device_clean and self._device is not None:
+            return
+        import jax.numpy as jnp
+        h = self._h
+        self._device = {
+            "key_inc": jnp.asarray(h["key_inc"]),
+            "ts": jnp.asarray(h["ts"]),
+            "txn_id": jnp.asarray(h["txn_id"]),
+            "kind": jnp.asarray(h["kind"]),
+            "status": jnp.asarray(h["status"]),
+            "active": jnp.asarray(h["active"]),
+        }
+        self._device_clean = True
+
+    # -- host index maintenance ----------------------------------------------
     def _attribute(self, mask: np.ndarray, queried: Set[RoutingKey]
                    ) -> List[Tuple[RoutingKey, TxnId]]:
         """Map a [T] slot mask back to (key, TxnId) incidences.  O(|result|):
-        the device did the O(T) scan; the host only touches hits."""
+        the array pass did the O(T) scan; the host only touches hits."""
         out: List[Tuple[RoutingKey, TxnId]] = []
         for slot in np.nonzero(mask)[0]:
             tid = self.txn_at.get(int(slot))
@@ -217,7 +518,7 @@ class TpuDepsResolver(DepsResolver):
         return heapq.heappop(self.free_key_slots)
 
     def _grow(self, txns: bool) -> None:
-        """Double capacity and rebuild the device state from host mirrors."""
+        """Double capacity and rebuild the index arrays from host mirrors."""
         if txns:
             self.free_slots = list(range(self._t, self._t * 2))
             heapq.heapify(self.free_slots)
@@ -229,13 +530,12 @@ class TpuDepsResolver(DepsResolver):
         self._rebuild()
 
     def _rebuild(self) -> None:
-        """Full host->device rebuild (capacity growth only — rare, amortised)."""
-        from ..ops import graph_state as gs
-        import jax.numpy as jnp
+        """Full rebuild of the canonical arrays (capacity growth only — rare,
+        amortised)."""
         t, k = self._t, self._k
         key_inc = np.zeros((t, k), dtype=np.int8)
-        ts = np.zeros((t, gs.TS_LANES), dtype=np.int32)
-        txn_id = np.zeros((t, gs.TS_LANES), dtype=np.int32)
+        ts = np.zeros((t, TS_LANES), dtype=np.int32)
+        txn_id = np.zeros((t, TS_LANES), dtype=np.int32)
         kind = np.zeros((t,), dtype=np.int8)
         status = np.zeros((t,), dtype=np.int8)
         active = np.zeros((t,), dtype=np.bool_)
@@ -246,73 +546,96 @@ class TpuDepsResolver(DepsResolver):
             kind[m.slot] = m.kind_code
             status[m.slot] = m.status
             active[m.slot] = True
-        self._state = gs.GraphState(
-            key_inc=jnp.asarray(key_inc), ts=jnp.asarray(ts),
-            txn_id=jnp.asarray(txn_id), kind=jnp.asarray(kind),
-            status=jnp.asarray(status),
-            adj=jnp.zeros((t, t), dtype=jnp.int8),
-            active=jnp.asarray(active))
+        self._h = {"key_inc": key_inc, "key_inc_f32": key_inc.T.astype(np.float32),
+                   "ts": ts, "txn_id": txn_id, "kind": kind, "status": status,
+                   "active": active}
+        self._device_clean = False
         self._dirty_txns.clear()
         self._clear_bits.clear()
         self._deactivate.clear()
 
     def _flush(self) -> None:
-        """Push buffered mutations to the device as batched scatters (eager
-        jnp ops: no per-batch-size recompilation; one fused update per burst)."""
-        from ..ops import graph_state as gs
-        import jax.numpy as jnp
-        if self._state is None:
+        """Apply buffered mutations to the canonical host arrays (in-place row
+        writes — O(rows changed)); the device copy goes stale and re-syncs
+        lazily if/when the device tier is next chosen."""
+        if self._h is None:
             self._rebuild()
             return
         if not (self._dirty_txns or self._clear_bits or self._deactivate):
             return
-        s = self._state
+        h = self._h
         # order matters: clears and deactivations target OLD occupants of a
         # slot; inserts (which may recycle that same slot) must land last
-        if self._clear_bits:
-            rows = np.asarray([r for r, _ in self._clear_bits], dtype=np.int32)
-            cols = np.asarray([c for _, c in self._clear_bits], dtype=np.int32)
-            s = s._replace(key_inc=s.key_inc.at[rows, cols].set(0))
-            self._clear_bits.clear()
+        for row, col in self._clear_bits:
+            h["key_inc"][row, col] = 0
+            h["key_inc_f32"][col, row] = 0.0
+        self._clear_bits.clear()
         if self._deactivate:
-            d = jnp.asarray(np.asarray(self._deactivate, dtype=np.int32))
-            s = s._replace(active=s.active.at[d].set(False),
-                           key_inc=s.key_inc.at[d].set(0),
-                           status=s.status.at[d].set(0))
+            d = np.asarray(self._deactivate, dtype=np.int32)
+            h["active"][d] = False
+            h["key_inc"][d] = 0
+            h["key_inc_f32"][:, d] = 0.0
+            h["status"][d] = 0
             self._deactivate.clear()
-        if self._dirty_txns:
-            dirty = sorted(self._dirty_txns)   # deterministic flush order
-            n = len(dirty)
-            slots = np.empty((n,), dtype=np.int32)
-            key_inc = np.zeros((n, self._k), dtype=np.int8)
-            ts = np.empty((n, gs.TS_LANES), dtype=np.int32)
-            txn_id = np.empty((n, gs.TS_LANES), dtype=np.int32)
-            kind = np.empty((n,), dtype=np.int8)
-            status = np.empty((n,), dtype=np.int8)
-            for i, tid in enumerate(dirty):
-                m = self.txns[tid]
-                slots[i] = m.slot
-                key_inc[i, [self.key_slot[rk] for rk in m.keys]] = 1
-                ts[i] = m.execute_at.pack_lanes()
-                txn_id[i] = tid.pack_lanes()
-                kind[i] = m.kind_code
-                status[i] = m.status
-            js = jnp.asarray(slots)
-            s = gs.GraphState(
-                key_inc=s.key_inc.at[js].set(jnp.asarray(key_inc)),
-                ts=s.ts.at[js].set(jnp.asarray(ts)),
-                txn_id=s.txn_id.at[js].set(jnp.asarray(txn_id)),
-                kind=s.kind.at[js].set(jnp.asarray(kind)),
-                status=s.status.at[js].set(jnp.asarray(status)),
-                adj=s.adj,
-                active=s.active.at[js].set(True))
-            self._dirty_txns.clear()
-        self._state = s
+        for tid in sorted(self._dirty_txns):    # deterministic flush order
+            m = self.txns[tid]
+            row = m.slot
+            h["key_inc"][row] = 0
+            h["key_inc_f32"][:, row] = 0.0
+            cols = [self.key_slot[rk] for rk in m.keys]
+            h["key_inc"][row, cols] = 1
+            h["key_inc_f32"][cols, row] = 1.0
+            h["ts"][row] = m.execute_at.pack_lanes()
+            h["txn_id"][row] = tid.pack_lanes()
+            h["kind"][row] = m.kind_code
+            h["status"][row] = m.status
+            h["active"][row] = True
+        self._dirty_txns.clear()
+        self._device_clean = False
 
     # -- introspection (tests / bench) ---------------------------------------
-    def device_state(self):
+    def host_index(self) -> dict:
         self._flush()
-        return self._state
+        return self._h
 
     def indexed_count(self) -> int:
         return len(self.txns)
+
+
+_CALIBRATED: Optional[float] = None
+
+
+def _calibrate_threshold() -> float:
+    """Measure one device launch round-trip and the host tier's element rate;
+    the device tier is worth it above elems ≈ host_rate × launch_rtt.
+    Process-wide (one measurement serves every store's resolver)."""
+    global _CALIBRATED
+    if _CALIBRATED is not None:
+        return _CALIBRATED
+    try:
+        import jax
+        import jax.numpy as jnp
+        from ..ops import deps_kernels as dk
+        t, k, b = 256, 64, 8
+        args = (jnp.zeros((t, k), jnp.int8), jnp.zeros((t, TS_LANES), jnp.int32),
+                jnp.zeros((t, TS_LANES), jnp.int32), jnp.zeros((t,), jnp.int8),
+                jnp.zeros((t,), jnp.int8), jnp.zeros((t,), jnp.bool_),
+                jnp.zeros((b, k), jnp.int8), jnp.zeros((b, TS_LANES), jnp.int32),
+                jnp.zeros((b,), jnp.int8))
+        jax.block_until_ready(dk.consult(*args))      # compile
+        t0 = time.perf_counter()
+        jax.block_until_ready(dk.consult(*args))
+        rtt = time.perf_counter() - t0
+        # host tier: ~f32 BLAS matmul; measure a representative pass
+        hq = np.random.default_rng(0)
+        a = (hq.random((256, 512)) < 0.1).astype(np.float32)
+        m = (hq.random((512, 4096)) < 0.1).astype(np.float32)
+        t0 = time.perf_counter()
+        for _ in range(3):
+            _ = a @ m
+        host_rate = 3 * a.shape[0] * a.shape[1] * m.shape[1] \
+            / (time.perf_counter() - t0)
+        _CALIBRATED = max(1e6, host_rate * rtt)
+    except Exception:  # noqa: BLE001 — no device: host tier only
+        _CALIBRATED = float("inf")
+    return _CALIBRATED

@@ -102,6 +102,15 @@ class Request(Message):
         """Replica must have adopted this epoch before processing (TxnRequest)."""
         return 0
 
+    def prefetch_specs(self, node: "Node"):
+        """Deps queries this request WILL issue when processed, as
+        (command_store, impl.resolver.QuerySpec) pairs — lets a coalesced
+        delivery window answer a whole batch's queries in one device launch
+        (TpuDepsResolver.prefetch).  Best-effort: over- or under-declaring is
+        harmless (unused answers are dropped; undeclared queries launch
+        individually)."""
+        return None
+
 
 class Reply(Message):
     __slots__ = ()

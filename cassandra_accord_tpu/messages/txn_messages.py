@@ -40,6 +40,34 @@ def worst_outcome(a, b):
     return a if order.index(a) < order.index(b) else b
 
 
+def _txn_query_specs(node, txn_id: TxnId, keys_or_ranges, before: Timestamp,
+                     want_max: bool):
+    """Declare the deps queries a PreAccept/Accept handler will issue, per
+    intersecting store (for delivery-window prefetch).  Key-domain only: range
+    txns use the host-side range table, not the resolver's device index."""
+    from ..impl.resolver import QuerySpec
+    if isinstance(keys_or_ranges, Ranges):
+        return None
+    rks = []
+    seen = set()
+    for key in keys_or_ranges:
+        rk = key.to_routing() if hasattr(key, "to_routing") else key
+        if rk not in seen:
+            seen.add(rk)
+            rks.append(rk)
+    out = []
+    for store in node.command_stores.all_stores():
+        local = store.current_ranges()
+        local_rks = [rk for rk in rks if local.contains(rk)]
+        if not local_rks:
+            continue
+        out.append((store, QuerySpec("kc", txn_id, local_rks, before)))
+        if want_max:
+            # commands.preaccept passes the UNFILTERED key list to max_conflict
+            out.append((store, QuerySpec("mc", None, rks, None)))
+    return out
+
+
 def calculate_partial_deps(safe_store: SafeCommandStore, txn_id: TxnId,
                            keys_or_ranges, before: Timestamp) -> Deps:
     """All active conflicting txns with txnId < before, witnessed by txn_id's kind."""
@@ -287,6 +315,13 @@ class PreAccept(TxnRequest):
         node.map_reduce_consume_local(scope, node.topology.min_epoch, self.max_epoch,
                                       map_fn, reduce_fn).begin(consume)
 
+    def prefetch_specs(self, node):
+        # mirrors the handler's two consults: max_conflict over ALL the txn's
+        # keys (commands.preaccept) and the deps walk over the store-local keys
+        # (map_reduce_active's by_rk filter), before = txnId
+        return _txn_query_specs(node, self.txn_id, self.partial_txn.keys,
+                                self.txn_id.as_timestamp(), want_max=True)
+
     def __repr__(self):
         return f"PreAccept({self.txn_id!r}, {self.scope!r})"
 
@@ -356,6 +391,13 @@ class Accept(TxnRequest):
 
         node.map_reduce_consume_local(scope, node.topology.min_epoch,
                                       execute_at.epoch, map_fn, reduce_fn).begin(consume)
+
+    def prefetch_specs(self, node):
+        # the Accept deps walk runs AFTER the self-registration, whose effect
+        # on its own answer is nil (the walk excludes txn_id) — the resolver's
+        # self-exemption makes the prefetched answer exact
+        return _txn_query_specs(node, self.txn_id, self.keys, self.execute_at,
+                                want_max=False)
 
     def __repr__(self):
         return f"Accept({self.txn_id!r}@{self.execute_at!r})"

@@ -155,6 +155,42 @@ def max_conflict_keys(index_key_inc: jax.Array,  # [T, K] int8
         mask)
 
 
+@jax.jit
+def consult(index_key_inc: jax.Array,   # [T, K] int8
+            index_ts: jax.Array,        # [T, 5] int32 executeAt
+            index_txn_id: jax.Array,    # [T, 5] int32
+            index_kind: jax.Array,      # [T] int8
+            index_status: jax.Array,    # [T] int8
+            index_active: jax.Array,    # [T] bool
+            batch_key_inc: jax.Array,   # [B, K] int8
+            batch_before: jax.Array,    # [B, 5] int32
+            batch_kind: jax.Array,      # [B] int8
+            ) -> Tuple[jax.Array, jax.Array]:
+    """The fused replica consult: one launch answers BOTH halves of a
+    PreAccept-class query batch — the dependency calculation
+    (mapReduceActive / overlap_join) and the timestamp-proposal max
+    (MaxConflicts / max_conflict_keys) — sharing the single key-overlap
+    matmul between them.  This is the per-message device round-trip
+    collapsed to one, and with B > 1 it is the whole delivery window's
+    deps traffic in one MXU dispatch.
+
+    Returns (deps [B, T] bool, max_lanes [B, 5] int32)."""
+    share_key = _bool_matmul(batch_key_inc, index_key_inc.T)             # [B, T]
+    started_before = ts_less(index_txn_id[None, :, :],
+                             batch_before[:, None, :])                   # [B, T]
+    witnesses = WITNESSES[batch_kind[:, None].astype(jnp.int32),
+                          index_kind[None, :].astype(jnp.int32)]         # [B, T]
+    eligible = index_active & (index_status != INVALIDATED)              # [T]
+    deps = share_key & started_before & witnesses & eligible[None, :]
+    mc_mask = share_key & index_active[None, :]
+    per_slot = jnp.where(ts_less(index_ts, index_txn_id)[:, None],
+                         index_txn_id, index_ts)                         # [T, 5]
+    max_lanes = _lex_max_masked(
+        jnp.broadcast_to(per_slot[None, :, :],
+                         mc_mask.shape + (per_slot.shape[-1],)), mc_mask)
+    return deps, max_lanes
+
+
 # ---------------------------------------------------------------------------
 # Transitive closure / elision
 # ---------------------------------------------------------------------------

@@ -203,3 +203,113 @@ def test_burn_with_verify_resolver():
     result = run_burn(seed=424242, ops=80, concurrency=8, topology_churn=True,
                       journal=True, resolver="verify")
     assert result.ops_ok > 0
+
+
+def test_parity_device_tier(monkeypatch):
+    """Force every consult onto the DEVICE tier (ops.deps_kernels.consult):
+    the MXU join must agree bit-for-bit with the cfk walk, like the host
+    tier does (the two tiers of impl/tpu_resolver._consult)."""
+    monkeypatch.setenv("ACCORD_TPU_TIER", "device")
+    rng = RandomSource(777)
+    store, verify = make_pair()
+    assert verify.tpu.tier == "device"
+    keys = [rk(i * 10) for i in range(8)]
+    hlc = 0
+    for _ in range(120):
+        roll = rng.next_float()
+        if roll < 0.5:
+            hlc += rng.next_int(1, 5)
+            t = tid(hlc, node=1 + rng.next_int(3),
+                    kind=rng.pick([TxnKind.WRITE, TxnKind.READ]))
+            ks = sorted({rng.pick(keys) for _ in range(rng.next_int(1, 4))})
+            register_both(store, verify, t, InternalStatus.PREACCEPTED, None, ks)
+        else:
+            hlc += 1
+            q = tid(hlc, kind=rng.pick([TxnKind.WRITE, TxnKind.READ]))
+            qk = sorted({rng.pick(keys) for _ in range(rng.next_int(1, 5))})
+            verify.key_conflicts(q, qk, q.as_timestamp())
+            verify.max_conflict_keys(qk)
+    assert verify.tpu.device_consults > 20
+    assert verify.tpu.host_consults == 0
+
+
+def test_prefetch_exact_and_interference():
+    """Prefetched answers serve only when provably equal to a live query:
+    self-registration is exempt; any other same-key mutation forces fallback."""
+    from cassandra_accord_tpu.impl.resolver import QuerySpec
+    store, verify = make_pair()
+    tpu = verify.tpu
+    a, b = tid(10), tid(20, node=2)
+    register_both(store, verify, a, InternalStatus.PREACCEPTED, None, [rk(0)])
+
+    # window with two upcoming preaccept consults: b on key 0 (interferes with
+    # c's registration below), c on key 10 (clean)
+    c = tid(30, node=3)
+    verify.prefetch([QuerySpec("mc", None, [rk(0)], None),
+                     QuerySpec("kc", b, [rk(0)], b.as_timestamp()),
+                     QuerySpec("mc", None, [rk(10)], None),
+                     QuerySpec("kc", c, [rk(10)], c.as_timestamp())])
+    h0 = tpu.prefetch_hits
+
+    # message 1: preaccept(b) on key 0 — mc hits clean; then register; the kc
+    # is served patched (b itself is the only delta, and b.txnId < b.txnId is
+    # false, so the patch adds nothing — sequential semantics preserved)
+    assert verify.max_conflict_keys([rk(0)]) is not None
+    register_both(store, verify, b, InternalStatus.PREACCEPTED, None, [rk(0)])
+    assert {t for _, t in verify.key_conflicts(b, [rk(0)], b.as_timestamp())} == {a}
+    assert tpu.prefetch_hits == h0 + 1
+    assert tpu.prefetch_patched >= 1
+
+    # message 2: preaccept(c), but on key 0 instead of the declared key 10 —
+    # b's registration dirtied key 0: the stale cached answer must not be
+    # served as-is; b (new since prefetch) is PATCHED in from the mirrors
+    h1 = tpu.prefetch_hits
+    got = verify.key_conflicts(c, [rk(0)], c.as_timestamp())
+    assert {t for _, t in got} == {a, b}   # sequential semantics: sees b
+    assert tpu.prefetch_hits == h1        # not a clean hit: patched or fallback
+    verify.end_batch()
+    assert tpu._cache is None
+
+
+def test_prefetch_accept_on_fresh_replica():
+    """An Accept-style walk (before = executeAt > txnId) on a replica that
+    never witnessed the txn: the prefetched answer lacks the txn, the handler
+    registers it, and the cfk oracle DOES report it (txnId < before) — the
+    self-exemption must not serve the stale answer; the patch must add it."""
+    from cassandra_accord_tpu.impl.resolver import QuerySpec
+    store, verify = make_pair()
+    a = tid(10)
+    register_both(store, verify, a, InternalStatus.PREACCEPTED, None, [rk(0)])
+    b = tid(20, node=2)
+    exec_at = Timestamp(1, 90, 0, 2)     # executeAt > b's txnId
+    verify.prefetch([QuerySpec("kc", b, [rk(0)], exec_at)])
+    # the Accept handler registers b (fresh here), THEN walks deps at exec_at
+    register_both(store, verify, b, InternalStatus.ACCEPTED, exec_at, [rk(0)])
+    got = verify.key_conflicts(b, [rk(0)], exec_at)
+    assert {t for _, t in got} == {a, b}   # parity-asserted vs the cfk walk
+
+
+def test_cluster_batch_window_parity():
+    """Delivery-window coalescing under the parity-asserting resolver: the
+    batched/prefetched fast path must agree with the cfk walk on every query,
+    and actually hit."""
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    cluster = Cluster(Topology(1, shards), seed=99, resolver="verify",
+                      batch_window_us=2_000)
+    results = []
+    for i in range(24):
+        # heavy same-key contention => intra-window interference paths run too
+        txn = list_txn([k(5)] if i % 3 == 0 else [],
+                       {k(5): f"v{i}", k(600 + (i % 4)): f"w{i}"})
+        results.append(cluster.nodes[1 + i % 3].coordinate(txn))
+    assert cluster.run_until(lambda: all(r.is_done() for r in results))
+    cluster.run_until_idle()
+    assert all(r.failure is None for r in results)
+    lists = {cluster.stores[n].get(k(5)) for n in cluster.nodes}
+    assert len(lists) == 1
+    hits = misses = 0
+    for n in cluster.nodes:
+        for store in cluster.nodes[n].command_stores.all_stores():
+            hits += store.resolver.tpu.prefetch_hits
+            misses += store.resolver.tpu.prefetch_misses
+    assert hits > 20, f"prefetch never hit (hits={hits}, misses={misses})"
