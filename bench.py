@@ -38,23 +38,27 @@ os.environ.setdefault("ACCORD_TPU_KEY_SLOTS", "64")
 # ---------------------------------------------------------------------------
 
 PROTO_SEED = 7
-PROTO_OPS = 600
-PROTO_CONC = 48
-# few hot keys + no GC in a benign run => per-key histories grow to hundreds
-# of entries, which is exactly where the reference-shaped per-key walk hurts
-# and the array-index consult (one vectorized pass / one MXU launch for a
-# whole delivery window) stays flat
-PROTO_KW = dict(nodes=3, rf=3, key_count=8, num_shards=1)
+# deep-contention config (the BASELINE.md config-3 shape: few keys, deep deps
+# chains): per-key histories grow into the thousands, where the
+# reference-shaped per-key walk scans O(history) per query and the array
+# consult (one vectorized pass / one MXU launch per delivery window) is flat
+PROTO_OPS = 2000
+PROTO_CONC = 64
+PROTO_KW = dict(nodes=3, rf=3, key_count=6, num_shards=1)
 
 
-def bench_protocol(resolver: str, batch_window_us: int, ops: int = PROTO_OPS):
+def bench_protocol(resolver: str, batch_window_us: int, ops: int = PROTO_OPS,
+                   reps: int = 2):
     from cassandra_accord_tpu.harness.burn import run_burn
-    t0 = time.perf_counter()
-    res = run_burn(seed=PROTO_SEED, ops=ops, concurrency=PROTO_CONC,
-                   resolver=resolver, batch_window_us=batch_window_us,
-                   **PROTO_KW)
-    dt = time.perf_counter() - t0
-    return res.ops_ok / dt, res
+    best, res = 0.0, None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = run_burn(seed=PROTO_SEED, ops=ops, concurrency=PROTO_CONC,
+                       resolver=resolver, batch_window_us=batch_window_us,
+                       **PROTO_KW)
+        dt = time.perf_counter() - t0
+        best = max(best, res.ops_ok / dt)
+    return best, res
 
 
 # ---------------------------------------------------------------------------
@@ -103,7 +107,9 @@ def make_host_tier(key_inc, ts, txn_id, kind, status, active):
     from cassandra_accord_tpu.impl.tpu_resolver import TpuDepsResolver
     r = TpuDepsResolver.__new__(TpuDepsResolver)   # host tier needs only _h
     r.host_consults = 0
+    # no covered bits in the synthetic index: live == full incidence
     r._h = {"key_inc": key_inc, "key_inc_f32": key_inc.T.astype(np.float32),
+            "live_f32": key_inc.T.astype(np.float32),
             "ts": ts, "txn_id": txn_id, "kind": kind, "status": status,
             "active": active}
     return lambda q, before, qkind: r._consult_host(q, before, qkind)
@@ -131,14 +137,20 @@ def bench_kernel(t, k=512, b=256, iters=20):
     rng = np.random.default_rng(42)
     key_inc, lanes, kind, status, active = _make_index(rng, t, k)
     q, before, qkind = _make_queries(rng, b, k, t)
-    dev = [jnp.asarray(x) for x in
-           (key_inc, lanes, lanes, kind, status, active, q, before, qkind)]
-    # warmup/compile
-    jax.block_until_ready(dk.consult(*dev))
-    t0 = time.perf_counter()
+    index_dev = [jnp.asarray(x) for x in
+                 (key_inc, key_inc, lanes, lanes, kind, status, active)]
+    # DISTINCT query batch per iteration: identical repeated computations can
+    # be served from caches (driver/tunnel level) and would overstate rates
+    batches = []
     for _ in range(iters):
-        out = dk.consult(*dev)
-    jax.block_until_ready(out)
+        qi, bi, ki = _make_queries(rng, b, k, t)
+        batches.append((jnp.asarray(qi), jnp.asarray(bi), jnp.asarray(ki)))
+    # warmup/compile
+    jax.block_until_ready(dk.consult(*index_dev, jnp.asarray(q),
+                                     jnp.asarray(before), jnp.asarray(qkind)))
+    t0 = time.perf_counter()
+    outs = [dk.consult(*index_dev, *bt) for bt in batches]
+    jax.block_until_ready(outs)
     dev_qps = iters * b / (time.perf_counter() - t0)
     # numpy-vectorized host baseline: the resolver's own host tier
     host_tier = make_host_tier(key_inc, lanes, lanes, kind, status, active)
@@ -159,7 +171,7 @@ def bench_kernel(t, k=512, b=256, iters=20):
 
 def main():
     # warm the jit caches so protocol timing measures steady state, not compiles
-    bench_protocol("tpu", batch_window_us=3_000, ops=40)
+    bench_protocol("tpu", batch_window_us=3_000, ops=40, reps=1)
     tpu_cps, tpu_res = bench_protocol("tpu", batch_window_us=3_000)
     cpu_cps, cpu_res = bench_protocol("cpu", batch_window_us=0)
     assert tpu_res.ops_ok == cpu_res.ops_ok, "workload mismatch"

@@ -286,7 +286,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         result.stats = dict(cluster.stats)
         # data-plane telemetry (tpu/verify resolvers): batching + tier choices
         tel = {"prefetch_hits": 0, "prefetch_patched": 0, "prefetch_misses": 0,
-               "host_consults": 0, "device_consults": 0}
+               "walk_consults": 0, "host_consults": 0, "device_consults": 0}
         for node in cluster.nodes.values():
             for store in node.command_stores.all_stores():
                 r = getattr(store.resolver, "tpu", store.resolver)

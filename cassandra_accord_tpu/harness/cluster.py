@@ -525,14 +525,23 @@ class Cluster:
         # even a batch of one PreAccept gains: its deps + max-conflict consults
         # fuse into a single launch instead of two
         per_store: Dict[object, List] = {}
-        for _at, _seq, request, _frm, _ctx in ready:
-            specs = request.prefetch_specs(node)
+        with_specs = []
+        for entry in ready:
+            specs = entry[2].prefetch_specs(node)
+            with_specs.append((entry, bool(specs)))
             for store, spec in specs or ():
                 per_store.setdefault(store, []).append(spec)
+        # deps-query-bearing requests drain FIRST: a Commit/Apply processed
+        # mid-window moves the covering bounds and invalidates the window's
+        # prefetched answers, so serve the queries before advancing state.
+        # Reordering within the window is legal network behavior (it is
+        # indistinguishable from jitter below the coalescing latency), and
+        # the (priority, arrival, seq) key keeps it deterministic.
+        with_specs.sort(key=lambda p: (not p[1], p[0][0], p[0][1]))
         for store, specs in per_store.items():
             store.resolver.prefetch(specs)
         try:
-            for _at, _seq, request, frm, ctx in ready:
+            for (_at, _seq, request, frm, ctx), _h in with_specs:
                 node.receive(request, frm, ctx)
         finally:
             for store in per_store:

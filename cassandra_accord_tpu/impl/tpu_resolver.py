@@ -14,35 +14,56 @@ kind-witness mask, plus the masked lexicographic max for the timestamp
 proposal — not the reference's scalar per-key scans
 (cfk/CommandsForKey.java:925-1000).
 
+TRANSITIVE ELISION (cfk/CommandsForKey.java:144-157; local/cfk.py
+map_reduce_active is the oracle): committed txns executing before the latest
+committed WRITE below the query bound are covered by it and excluded from
+deps answers.  The index maintains this incrementally as per-incidence
+COVERED bits (monotone while the per-key covering bound E_k = max committed
+write executeAt only grows; prune of a covering write un-covers survivors):
+
+- ``live`` incidence matrix = full incidence minus covered bits → one matmul
+  answers an elided deps query EXACTLY for any bound above E_k (the common
+  case: PreAccept/Accept bounds are fresh timestamps);
+- bounds at-or-below E_k take a per-key vectorized pass that recomputes the
+  covering write for that bound (rare; exact);
+- max-conflict always uses the FULL incidence (elision never applies to the
+  timestamp proposal).
+
 Two execution tiers answer the SAME join bit-identically, picked per call by
 a cost model (the accelerator-native split: dispatch to the MXU only when the
 work amortizes launch+transfer):
 
-- host tier  — the join as one vectorized numpy pass over the index arrays
-               (BLAS f32 matmul + lane-wise lex compares).  No launch
-               overhead; serves small windows.
+- host tier  — one vectorized numpy pass (BLAS f32 matmuls + lane-wise lex
+               compares).  No launch overhead; serves small windows.
 - device tier — ops.deps_kernels.consult on the TPU: bf16 MXU matmul over
-               [B, K] × [K, T].  Serves large batches / deep indexes, where
-               it is 30-80× the host tier (bench.py kernel_scaling).
+               [B, K] × [K, T].  Serves large batches / deep indexes
+               (bench.py kernel_scaling).
 
 The canonical index lives in host numpy (mutations are in-place row writes);
-the device copy is synced lazily when the device tier is chosen.  The cost
-model self-calibrates: it measures one launch round-trip and the host tier's
-element throughput, then dispatches by B·T·K.  Tier choice never affects
-answers (both tiers are parity-checked against the cfk walk by
+the device copy is synced lazily when the device tier is chosen.  Tier choice
+never affects answers (both tiers are parity-checked against the cfk walk by
 VerifyDepsResolver), only speed.
 
 Queries batch across messages: a coalesced delivery window
 (harness/cluster.py ``batch_window_us``) declares its upcoming
 PreAccept/Accept consults via ``prefetch``, which answers ALL of them in one
-fused consult (one numpy pass or one MXU launch).  Live queries are then
-served from the cached answers with EXACT sequential semantics: every index
-mutation since the prefetch marks its keys dirty, and a cached answer is only
-used when no dirty key intersects the query — except the querying txn's own
-registration, which provably cannot change its own answer (the deps walk
-excludes ``by`` host-side, and the timestamp consult runs before the
-self-registration).  Anything else falls back to an individual consult, so
-batching is a pure fast path.
+fused consult.  Live queries are then served from the cached answers with
+EXACT sequential semantics:
+
+- every index mutation since the prefetch marks its keys dirty;
+- a clean cached answer is served only when no dirty key intersects it;
+- dirt from txns NEW since the prefetch is PATCHED in from the (always
+  current, therefore sequentially exact) host mirrors — including the
+  querying txn itself, whose own registration precedes its deps walk;
+- a WRITE entering the committed lattice mid-window marks its keys HARD
+  (its arrival moves the covering bound for arbitrary bounds on those keys);
+  hard keys always fall back;
+- patching is only attempted for bounds above E_k (below it the covered bits
+  are not the right elision set), and upgrades of pre-existing txns always
+  fall back.
+
+Anything unprovable falls back to an individual consult, so batching is a
+pure fast path.
 
 Slot lifecycle: slots are recycled once a txn is fully pruned from every key
 it touched (the cfk prune protocol driven by RedundantBefore GC,
@@ -59,7 +80,7 @@ from typing import Dict, List, Optional, Set, Tuple, TYPE_CHECKING
 import numpy as np
 
 from ..primitives.keys import Range, RoutingKey
-from ..primitives.timestamp import Timestamp, TxnId
+from ..primitives.timestamp import Timestamp, TxnId, TxnKind
 from ..utils.invariants import check_state
 from .resolver import DepsResolver
 
@@ -68,21 +89,11 @@ if TYPE_CHECKING:
     from ..local.cfk import InternalStatus
 
 TS_LANES = 5
-
-_INVALIDATED: Optional[int] = None
-
-
-def _invalidated_code() -> int:
-    """InternalStatus.INVALIDATED, resolved lazily from the one source of
-    truth (local.cfk) so the host tier's eligibility mask can never diverge
-    from the cfk walk or the device kernel."""
-    global _INVALIDATED
-    if _INVALIDATED is None:
-        from ..local.cfk import InternalStatus
-        _INVALIDATED = int(InternalStatus.INVALIDATED)
-    return _INVALIDATED
+_WRITE = int(TxnKind.WRITE)
 
 _WITNESSES: Optional[np.ndarray] = None
+_INVALIDATED: Optional[int] = None
+_COMMITTED: Optional[int] = None
 
 
 def _witnesses() -> np.ndarray:
@@ -91,6 +102,17 @@ def _witnesses() -> np.ndarray:
         from ..ops.deps_kernels import _witness_table
         _WITNESSES = _witness_table()
     return _WITNESSES
+
+
+def _status_codes() -> Tuple[int, int]:
+    """(COMMITTED, INVALIDATED) from the one source of truth (local.cfk), so
+    the host tier's masks can never diverge from the cfk walk or the kernel."""
+    global _COMMITTED, _INVALIDATED
+    if _COMMITTED is None:
+        from ..local.cfk import InternalStatus
+        _COMMITTED = int(InternalStatus.COMMITTED)
+        _INVALIDATED = int(InternalStatus.INVALIDATED)
+    return _COMMITTED, _INVALIDATED
 
 
 def _pack_before(before: Timestamp) -> Tuple[int, int, int, int, int]:
@@ -113,9 +135,19 @@ def _lex_less(a: np.ndarray, b: np.ndarray) -> np.ndarray:
     return lt
 
 
+def _lex_max_rows(rows: np.ndarray) -> np.ndarray:
+    """Lexicographic max over rows [N, 5] (N >= 1)."""
+    sel = np.ones(rows.shape[0], dtype=bool)
+    for lane in range(TS_LANES):
+        best = rows[sel, lane].max()
+        sel = sel & (rows[:, lane] == best)
+    return rows[np.flatnonzero(sel)[0]]
+
+
 class _TxnMirror:
-    """Host bookkeeping for one indexed txn (rebuilds + attribution)."""
-    __slots__ = ("slot", "kind_code", "status", "execute_at", "keys")
+    """Host bookkeeping for one indexed txn (rebuilds + attribution + the
+    covered-key set for transitive elision)."""
+    __slots__ = ("slot", "kind_code", "status", "execute_at", "keys", "covered")
 
     def __init__(self, slot: int, kind_code: int, status: int,
                  execute_at: Timestamp, keys: Set[RoutingKey]):
@@ -124,6 +156,7 @@ class _TxnMirror:
         self.status = status
         self.execute_at = execute_at
         self.keys = keys
+        self.covered: Set[RoutingKey] = set()
 
 
 class TpuDepsResolver(DepsResolver):
@@ -144,10 +177,18 @@ class TpuDepsResolver(DepsResolver):
         heapq.heapify(self.free_slots)
         self.free_key_slots: List[int] = list(range(key_capacity))
         heapq.heapify(self.free_key_slots)
-        # pending (txn_id) inserts/updates and (slot, key_slot) bit ops
+        # transitive-elision bookkeeping (mirrors cfk._committed_writes +
+        # the covering bound per key)
+        self.key_maxw: Dict[RoutingKey, Timestamp] = {}      # E_k
+        self.key_cw: Dict[RoutingKey, Dict[TxnId, Timestamp]] = {}
+        self.key_uncovered: Dict[RoutingKey, Set[TxnId]] = {}
+        self.key_covered: Dict[RoutingKey, Set[TxnId]] = {}
+        # pending (txn_id) inserts/updates, (slot, key_slot) bit ops, and
+        # chronological live-matrix ops (cover=0 / uncover=1)
         self._dirty_txns: Set[TxnId] = set()
         self._clear_bits: List[Tuple[int, int]] = []
         self._deactivate: List[int] = []
+        self._live_ops: List[Tuple[int, int, int]] = []
         self._t = txn_capacity
         self._k = key_capacity
         self._h: Optional[dict] = None   # canonical numpy index (lazy)
@@ -156,12 +197,19 @@ class TpuDepsResolver(DepsResolver):
         # tier selection: 'auto' cost model, or forced for tests/benches
         self.tier = os.environ.get("ACCORD_TPU_TIER", "auto")
         self._threshold_elems: Optional[float] = None
+        # below this many indexed txns the per-key scalar walk (the cfk
+        # oracle itself) beats the vectorized tiers' fixed overhead — the
+        # third rung of the cost ladder: walk / host-vector / MXU
+        self._walk_max = int(os.environ.get("ACCORD_TPU_WALK_MAX", "384"))
+        self._walk: Optional[DepsResolver] = None
+        self.walk_consults = 0
         self.host_consults = 0
         self.device_consults = 0
         # prefetched-answer cache for the current delivery window (None = no
-        # window active): sig -> answer, plus keys dirtied since the prefetch
+        # window active): sig -> answer, plus keys dirtied/hardened since
         self._cache: Optional[Dict[tuple, object]] = None
         self._cache_dirty: Dict[RoutingKey, Set[TxnId]] = {}
+        self._cache_hard: Set[RoutingKey] = set()
         self._prefetch_preexisting: Set[TxnId] = set()
         self.prefetch_hits = 0
         self.prefetch_patched = 0
@@ -171,7 +219,9 @@ class TpuDepsResolver(DepsResolver):
     def register(self, txn_id: TxnId, status, execute_at, keys) -> None:
         from ..local.cfk import InternalStatus as IS
         status_i = int(status)
+        committed_i, invalidated_i = _status_codes()
         m = self.txns.get(txn_id)
+        was: Optional[int] = None if m is None else m.status
         if m is None:
             slot = self._alloc_slot()
             ea = execute_at if execute_at is not None else txn_id.as_timestamp()
@@ -179,11 +229,12 @@ class TpuDepsResolver(DepsResolver):
             self.txns[txn_id] = m
             self.txn_at[slot] = txn_id
         else:
-            # monotonic status; executeAt moves on upgrade or while ACCEPTED
+            # monotonic status; executeAt moves on upgrade or while ACCEPTED,
+            # and is FINAL from COMMITTED on (cfk.update's invariant)
             if status_i > m.status:
-                m.status = status_i
-                if execute_at is not None:
+                if execute_at is not None and m.status < committed_i:
                     m.execute_at = execute_at
+                m.status = status_i
             elif status_i == m.status and execute_at is not None \
                     and status_i == int(IS.ACCEPTED):
                 m.execute_at = execute_at
@@ -209,26 +260,112 @@ class TpuDepsResolver(DepsResolver):
             # upgrade changes its contribution on every key it touches
             for rk in m.keys:
                 self._cache_dirty.setdefault(rk, set()).add(txn_id)
+        if (was is None or was < committed_i) \
+                and committed_i <= m.status != invalidated_i:
+            self._on_committed(txn_id, m)
+        elif added_key and m.status != invalidated_i and committed_i <= m.status:
+            # already-committed txn gained keys: index its committed presence
+            # on the new keys too (same lattice-entry handling, new keys only)
+            self._on_committed(txn_id, m)
+
+    def _on_committed(self, txn_id: TxnId, m: _TxnMirror) -> None:
+        """The txn entered the committed lattice (executeAt now final):
+        maintain the covering bounds and covered bits (cfk elision mirror)."""
+        coverable = TxnKind.WRITE.witnesses(TxnKind(m.kind_code))
+        is_w = m.kind_code == _WRITE
+        for rk in m.keys:
+            cw = self.key_cw.get(rk)
+            if cw is not None and txn_id in cw:
+                continue    # this key already processed (added-keys re-entry)
+            if rk in self.key_covered and txn_id in self.key_covered[rk]:
+                continue
+            e_k = self.key_maxw.get(rk)
+            if coverable and e_k is not None and m.execute_at < e_k:
+                self._cover(rk, txn_id, m)
+            elif coverable:
+                self.key_uncovered.setdefault(rk, set()).add(txn_id)
+            if is_w:
+                self.key_cw.setdefault(rk, {})[txn_id] = m.execute_at
+                if self._cache is not None:
+                    # a new committed write moves the covering bound for
+                    # arbitrary query bounds on this key: cached answers there
+                    # are unservable for the rest of the window
+                    self._cache_hard.add(rk)
+                if e_k is None or m.execute_at > e_k:
+                    self.key_maxw[rk] = m.execute_at
+                    self._sweep(rk)
+
+    def _sweep(self, rk: RoutingKey) -> None:
+        """E_k advanced: cover every committed coverable txn now below it."""
+        e_k = self.key_maxw[rk]
+        unc = self.key_uncovered.get(rk)
+        if not unc:
+            return
+        for t in list(unc):
+            mt = self.txns.get(t)
+            if mt is not None and mt.execute_at < e_k:
+                unc.discard(t)
+                self._cover(rk, t, mt)
+
+    def _cover(self, rk: RoutingKey, txn_id: TxnId, m: _TxnMirror) -> None:
+        m.covered.add(rk)
+        self.key_covered.setdefault(rk, set()).add(txn_id)
+        self._live_ops.append((m.slot, self.key_slot[rk], 0))
 
     def on_pruned(self, key: RoutingKey, txn_ids) -> None:
         self._cache = None   # prunes mid-window are rare: drop the whole cache
         ks = self.key_slot.get(key)
         if ks is None:
             return
+        cw = self.key_cw.get(key)
+        cw_removed = False
         for txn_id in txn_ids:
             m = self.txns.get(txn_id)
             if m is None or key not in m.keys:
                 continue
             m.keys.discard(key)
+            m.covered.discard(key)
             self._clear_bits.append((m.slot, ks))
+            if cw is not None and cw.pop(txn_id, None) is not None:
+                cw_removed = True
+            u = self.key_uncovered.get(key)
+            if u is not None:
+                u.discard(txn_id)
+            c = self.key_covered.get(key)
+            if c is not None:
+                c.discard(txn_id)
             self._release_key(key)
             if not m.keys:
-                # fully pruned: recycle the slot
+                # fully pruned: recycle the slot — purging any buffered
+                # cover/uncover ops for it, which must never replay onto a
+                # future occupant of the same slot
                 self._deactivate.append(m.slot)
+                if self._live_ops:
+                    self._live_ops = [op for op in self._live_ops
+                                      if op[0] != m.slot]
                 del self.txns[txn_id]
                 del self.txn_at[m.slot]
                 self._dirty_txns.discard(txn_id)
                 heapq.heappush(self.free_slots, m.slot)
+        if cw_removed and key in self.key_slot:
+            # the covering bound may have receded: un-cover survivors at or
+            # above the new bound (cfk recomputes per query; we re-expose)
+            new_e = max(cw.values()) if cw else None
+            old_e = self.key_maxw.get(key)
+            if new_e != old_e:
+                if new_e is None:
+                    self.key_maxw.pop(key, None)
+                else:
+                    self.key_maxw[key] = new_e
+                for t in list(self.key_covered.get(key, ())):
+                    mt = self.txns.get(t)
+                    if mt is None:
+                        continue
+                    if new_e is None or not mt.execute_at < new_e:
+                        self.key_covered[key].discard(t)
+                        mt.covered.discard(key)
+                        self.key_uncovered.setdefault(key, set()).add(t)
+                        self._live_ops.append((mt.slot, ks, 1))
 
     def _release_key(self, key: RoutingKey) -> None:
         """Drop a live incidence; recycle the key slot when none remain (the
@@ -238,34 +375,55 @@ class TpuDepsResolver(DepsResolver):
             self.key_refs[key] = n
         else:
             self.key_refs.pop(key, None)
+            for d in (self.key_maxw, self.key_cw, self.key_uncovered,
+                      self.key_covered):
+                d.pop(key, None)
             ks = self.key_slot.pop(key, None)
             if ks is not None:
+                # purge buffered cover/uncover ops on the recycled COLUMN —
+                # they must never replay onto a future key in this slot
+                if self._live_ops:
+                    self._live_ops = [op for op in self._live_ops
+                                      if op[1] != ks]
                 heapq.heappush(self.free_key_slots, ks)
 
     # -- batched prefetch (delivery-window coalescing) ------------------------
     def prefetch(self, specs) -> None:
         """Answer every declared query in ONE fused consult and cache the
-        answers for the window (see module doc for the exactness rule)."""
+        answers for the window (see module doc for the exactness rules).
+        Specs whose bound is at/below a queried key's covering bound take the
+        exact per-key slow path instead of the batched matmul."""
+        if self._use_walk():
+            # below the vectorization threshold the walk answers each query
+            # cheaper than a batch pass + cache bookkeeping
+            self._cache = None
+            return
         self._cache = {}
         self._cache_dirty = {}
+        self._cache_hard = set()
         # ids indexed as of the prefetch: mutations by NEW txns can be patched
         # into cached answers exactly; upgrades of these force a fallback
         self._prefetch_preexisting = set(self.txns)
         live: List[Tuple[tuple, str, List[RoutingKey], object]] = []
+        slow: List[Tuple[tuple, List[RoutingKey], object, TxnId]] = []
         for spec in specs:
             known = [rk for rk in spec.keys if rk in self.key_slot]
             if spec.op == "kc":
                 sig = ("kc", spec.by, frozenset(known), spec.before)
                 if not known or not self.txns:
                     self._cache[sig] = []
-                    continue
+                elif self._all_fast(known, spec.before):
+                    live.append((sig, "kc", known, spec.before))
+                else:
+                    slow.append((sig, known, spec.before, spec.by))
             else:
                 sig = ("mc", frozenset(known))
                 if not known or not self.txns:
                     self._cache[sig] = None
-                    continue
-            live.append((sig, spec.op, known,
-                         spec.before if spec.op == "kc" else None))
+                else:
+                    live.append((sig, "mc", known, None))
+        for sig, known, before, by in slow:
+            self._cache[sig] = self._slow_hits(by, known, before)
         if not live:
             return
         b = len(live)
@@ -289,21 +447,25 @@ class TpuDepsResolver(DepsResolver):
     def end_batch(self) -> None:
         self._cache = None
         self._cache_dirty = {}
+        self._cache_hard = set()
 
-    def _cached(self, sig, known, exempt: Optional[TxnId]):
-        """A cached answer, made exact against mutations since the prefetch:
+    def _fast(self, rk: RoutingKey, before: Timestamp) -> bool:
+        """Covered bits implement elision exactly for this (key, bound) iff
+        the bound is above the covering bound E_k."""
+        e_k = self.key_maxw.get(rk)
+        return e_k is None or e_k < before
 
-        - keys dirtied only by ``exempt`` (the querying txn itself — excluded
-          from its own deps answer host-side) need nothing;
-        - keys dirtied by txns NEW since the prefetch are patched with those
-          txns' exact contributions from the (always-current) host mirrors —
-          at call time the mirrors ARE the sequential state, so the patched
-          answer equals a live query's;
-        - keys dirtied by an UPGRADE of a pre-existing txn force a fallback
-          (its base contribution is already folded in and cannot be unpicked).
+    def _all_fast(self, known, before: Timestamp) -> bool:
+        return all(self._fast(rk, before) for rk in known)
 
-        Returns (hit, answer, delta_ids) — delta_ids the new txns to patch in
-        (empty on clean hits); (False, None, None) on miss/fallback."""
+    def _cached(self, sig, known, exempt: Optional[TxnId],
+                before: Optional[Timestamp]):
+        """A cached answer, made exact against mutations since the prefetch
+        (module doc): hard keys and pre-existing upgrades fall back; NEW-txn
+        dirt is patched; patching requires the bound above E_k (kc only;
+        before=None means mc, where elision never applies).
+
+        Returns (hit, answer, delta_ids); (False, None, None) on fallback."""
         if self._cache is None:
             return False, None, None
         if sig not in self._cache:
@@ -311,9 +473,13 @@ class TpuDepsResolver(DepsResolver):
             return False, None, None
         delta_ids: Set[TxnId] = set()
         dirty = self._cache_dirty
-        if dirty:
+        hard = self._cache_hard
+        if dirty or hard:
             pre = self._prefetch_preexisting
             for rk in known:
+                if rk in hard:
+                    self.prefetch_misses += 1
+                    return False, None, None
                 for d in dirty.get(rk, ()):
                     if d == exempt and d in pre:
                         # upgrade of the querying txn itself: kc-invariant
@@ -321,6 +487,9 @@ class TpuDepsResolver(DepsResolver):
                         # to pre-existing txns nuke the cache in register)
                         continue
                     if d in pre or d not in self.txns:
+                        self.prefetch_misses += 1
+                        return False, None, None
+                    if before is not None and not self._fast(rk, before):
                         self.prefetch_misses += 1
                         return False, None, None
                     # NEW txns — including the querying txn itself, which the
@@ -334,35 +503,55 @@ class TpuDepsResolver(DepsResolver):
             self.prefetch_hits += 1
         return True, self._cache[sig], delta_ids
 
+    def _use_walk(self) -> bool:
+        if self.tier == "auto":
+            return len(self.txns) <= self._walk_max
+        return self.tier == "walk"
+
+    def _walk_tier(self) -> DepsResolver:
+        """The scalar per-key cfk walk (the oracle itself) as the smallest
+        rung of the cost ladder — at shallow indexes its near-zero constant
+        factor beats any vectorized pass."""
+        if self._walk is None:
+            from .resolver import CpuDepsResolver
+            self._walk = CpuDepsResolver(self.store)
+        self.walk_consults += 1
+        return self._walk
+
     # -- queries -------------------------------------------------------------
     def key_conflicts(self, by: TxnId, keys, before: Timestamp):
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
             return []
+        if self._use_walk():
+            return self._walk_tier().key_conflicts(by, keys, before)
         hit, ans, delta = self._cached(("kc", by, frozenset(known), before),
-                                       known, by)
+                                       known, by, before)
         if hit:
             out = list(ans)
             if delta:
                 known_set = set(known)
                 wit = by.kind.witnesses
-                from ..local.cfk import InternalStatus as IS
-                inval = int(IS.INVALIDATED)
+                _, invalidated_i = _status_codes()
                 for d in sorted(delta):
                     m = self.txns[d]
-                    if m.status == inval or not wit(d.kind) \
+                    if m.status == invalidated_i or not wit(TxnKind(m.kind_code)) \
                             or not d.as_timestamp() < before:
                         continue
-                    for rk in m.keys & known_set:
+                    # a NEW committed txn below the covering bound is elided
+                    # by the cfk walk too: honor its covered set
+                    for rk in (m.keys - m.covered) & known_set:
                         out.append((rk, d))
             return out
-        q = np.zeros((1, self._k), dtype=np.int8)
-        for rk in known:
-            q[0, self.key_slot[rk]] = 1
-        before_lanes = np.asarray([_pack_before(before)], dtype=np.int32)
-        kind = np.asarray([int(by.kind)], dtype=np.int8)
-        deps, _ = self._consult(q, before_lanes, kind, want_max=False)
-        return self._attribute(deps[0], set(known))
+        if self._all_fast(known, before):
+            q = np.zeros((1, self._k), dtype=np.int8)
+            for rk in known:
+                q[0, self.key_slot[rk]] = 1
+            before_lanes = np.asarray([_pack_before(before)], dtype=np.int32)
+            kind = np.asarray([int(by.kind)], dtype=np.int8)
+            deps, _ = self._consult(q, before_lanes, kind, want_max=False)
+            return self._attribute(deps[0], set(known))
+        return self._slow_hits(by, known, before)
 
     def range_conflicts(self, by: TxnId, rng: Range, before: Timestamp):
         keys = [rk for rk in self.key_slot if rng.contains(rk)]
@@ -372,7 +561,10 @@ class TpuDepsResolver(DepsResolver):
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
             return None
-        hit, ans, delta = self._cached(("mc", frozenset(known)), known, None)
+        if self._use_walk():
+            return self._walk_tier().max_conflict_keys(keys)
+        hit, ans, delta = self._cached(("mc", frozenset(known)), known, None,
+                                       None)
         if hit:
             if delta:
                 known_set = set(known)
@@ -399,9 +591,11 @@ class TpuDepsResolver(DepsResolver):
     # -- the fused consult: tier dispatch ------------------------------------
     def _consult(self, q: np.ndarray, before: np.ndarray, kind: np.ndarray,
                  want_deps: bool = True, want_max: bool = True):
-        """Answer a [B]-query batch: (deps [B, T] bool, max_lanes [B, 5]).
-        Host and device tiers compute the identical join; the cost model picks
-        by B·T·K vs the calibrated launch-amortization threshold."""
+        """Answer a [B]-query batch: (deps [B, T] bool over the LIVE index,
+        max_lanes [B, 5] over the FULL index).  Callers guarantee every deps
+        row's bound is above its keys' covering bounds (fast rows).  Host and
+        device tiers compute the identical join; the cost model picks by
+        B·T·K vs the calibrated launch-amortization threshold."""
         self._flush()
         b = q.shape[0]
         if self.tier == "device" or (
@@ -422,31 +616,32 @@ class TpuDepsResolver(DepsResolver):
         return self._threshold_elems
 
     def _consult_host(self, q, before, kind, want_deps=True, want_max=True):
-        """The join as one vectorized numpy pass (BLAS f32 matmul — exact for
+        """The join as one vectorized numpy pass (BLAS f32 matmuls — exact for
         0/1 values — + lane-wise lex compares).  Mirrors ops.deps_kernels.
         consult bit-for-bit."""
         self.host_consults += 1
         h = self._h
-        share = (q.astype(np.float32) @ h["key_inc_f32"]) > 0.0          # [B,T]
+        committed_i, invalidated_i = _status_codes()
         deps = None
         if want_deps:
+            share_live = (q.astype(np.float32) @ h["live_f32"]) > 0.0       # [B,T]
             started = _lex_less(h["txn_id"][None, :, :], before[:, None, :])
             wit = _witnesses()[kind[:, None].astype(np.int64),
                                h["kind"][None, :].astype(np.int64)]
-            eligible = h["active"] & (h["status"] != _invalidated_code())
-            deps = share & started & wit & eligible[None, :]
+            eligible = h["active"] & (h["status"] != invalidated_i)
+            deps = share_live & started & wit & eligible[None, :]
         max_lanes = None
         if want_max:
-            mc_mask = share & h["active"][None, :]
+            share_full = (q.astype(np.float32) @ h["key_inc_f32"]) > 0.0    # [B,T]
+            mc_mask = share_full & h["active"][None, :]
             per_slot = np.where(_lex_less(h["ts"], h["txn_id"])[:, None],
-                                h["txn_id"], h["ts"])                    # [T,5]
+                                h["txn_id"], h["ts"])                       # [T,5]
             b = q.shape[0]
             tie = mc_mask
             max_lanes = np.zeros((b, TS_LANES), dtype=np.int64)
             for lane in range(TS_LANES):
                 vals = np.where(tie, per_slot[None, :, lane], -1)
-                best = vals.max(axis=1) if vals.shape[1] else \
-                    np.full((b,), -1, dtype=np.int64)
+                best = vals.max(axis=1)
                 tie = tie & (per_slot[None, :, lane] == best[:, None])
                 max_lanes[:, lane] = np.maximum(best, 0)
         return deps, max_lanes
@@ -471,8 +666,8 @@ class TpuDepsResolver(DepsResolver):
                 [kind, np.zeros((b_pad - b,), dtype=kind.dtype)])
         s = self._device
         deps, max_lanes = jax.device_get(dk.consult(
-            s["key_inc"], s["ts"], s["txn_id"], s["kind"], s["status"],
-            s["active"], jnp.asarray(q), jnp.asarray(before),
+            s["live_inc"], s["key_inc"], s["ts"], s["txn_id"], s["kind"],
+            s["status"], s["active"], jnp.asarray(q), jnp.asarray(before),
             jnp.asarray(kind)))
         return deps[:b], max_lanes[:b]
 
@@ -485,6 +680,7 @@ class TpuDepsResolver(DepsResolver):
         h = self._h
         self._device = {
             "key_inc": jnp.asarray(h["key_inc"]),
+            "live_inc": jnp.asarray((h["live_f32"].T > 0).astype(np.int8)),
             "ts": jnp.asarray(h["ts"]),
             "txn_id": jnp.asarray(h["txn_id"]),
             "kind": jnp.asarray(h["kind"]),
@@ -493,17 +689,53 @@ class TpuDepsResolver(DepsResolver):
         }
         self._device_clean = True
 
+    # -- the exact per-key path (bounds at/below the covering bound) ---------
+    def _slow_hits(self, by: TxnId, known, before: Timestamp
+                   ) -> List[Tuple[RoutingKey, TxnId]]:
+        """Per-key vectorized recompute of the covering write FOR THIS BOUND —
+        the exact analog of cfk.map_reduce_active's maxCommittedWriteBefore
+        search (rare: only bounds at/below E_k take this)."""
+        self._flush()
+        self.host_consults += 1
+        h = self._h
+        committed_i, invalidated_i = _status_codes()
+        bl = np.asarray(_pack_before(before), dtype=np.int64)
+        started = _lex_less(h["txn_id"], bl)                    # [T]
+        wit = _witnesses()[int(by.kind), h["kind"].astype(np.int64)]
+        eligible = h["active"] & (h["status"] != invalidated_i)
+        committed = (h["status"] >= committed_i) & (h["status"] != invalidated_i)
+        write_wit = _witnesses()[_WRITE, h["kind"].astype(np.int64)]
+        is_w = h["kind"] == _WRITE
+        ea_before = _lex_less(h["ts"], bl)                      # [T]
+        out: List[Tuple[RoutingKey, TxnId]] = []
+        for rk in known:
+            col = h["key_inc"][:, self.key_slot[rk]] != 0
+            cand = col & started & wit & eligible
+            cw = col & committed & is_w & ea_before
+            if cw.any():
+                maxcw = _lex_max_rows(h["ts"][cw])
+                elide = committed & _lex_less(h["ts"], maxcw) & write_wit
+                cand = cand & ~elide
+            for slot in np.nonzero(cand)[0]:
+                tid = self.txn_at.get(int(slot))
+                if tid is not None:
+                    out.append((rk, tid))
+        return out
+
     # -- host index maintenance ----------------------------------------------
     def _attribute(self, mask: np.ndarray, queried: Set[RoutingKey]
                    ) -> List[Tuple[RoutingKey, TxnId]]:
-        """Map a [T] slot mask back to (key, TxnId) incidences.  O(|result|):
-        the array pass did the O(T) scan; the host only touches hits."""
+        """Map a [T] slot mask (over the LIVE index) back to (key, TxnId)
+        incidences, excluding covered keys.  O(|result|): the array pass did
+        the O(T) scan; the host only touches hits."""
         out: List[Tuple[RoutingKey, TxnId]] = []
         for slot in np.nonzero(mask)[0]:
             tid = self.txn_at.get(int(slot))
             if tid is None:
                 continue
-            for rk in self.txns[tid].keys & queried:
+            m = self.txns[tid]
+            keys = (m.keys - m.covered) if m.covered else m.keys
+            for rk in keys & queried:
                 out.append((rk, tid))
         return out
 
@@ -534,25 +766,31 @@ class TpuDepsResolver(DepsResolver):
         amortised)."""
         t, k = self._t, self._k
         key_inc = np.zeros((t, k), dtype=np.int8)
+        live_f32 = np.zeros((k, t), dtype=np.float32)
         ts = np.zeros((t, TS_LANES), dtype=np.int32)
         txn_id = np.zeros((t, TS_LANES), dtype=np.int32)
         kind = np.zeros((t,), dtype=np.int8)
         status = np.zeros((t,), dtype=np.int8)
         active = np.zeros((t,), dtype=np.bool_)
         for tid, m in self.txns.items():
-            key_inc[m.slot, [self.key_slot[rk] for rk in m.keys]] = 1
+            cols = [self.key_slot[rk] for rk in m.keys]
+            key_inc[m.slot, cols] = 1
+            live_cols = [self.key_slot[rk] for rk in m.keys - m.covered]
+            live_f32[live_cols, m.slot] = 1.0
             ts[m.slot] = m.execute_at.pack_lanes()
             txn_id[m.slot] = tid.pack_lanes()
             kind[m.slot] = m.kind_code
             status[m.slot] = m.status
             active[m.slot] = True
         self._h = {"key_inc": key_inc, "key_inc_f32": key_inc.T.astype(np.float32),
+                   "live_f32": live_f32,
                    "ts": ts, "txn_id": txn_id, "kind": kind, "status": status,
                    "active": active}
         self._device_clean = False
         self._dirty_txns.clear()
         self._clear_bits.clear()
         self._deactivate.clear()
+        self._live_ops.clear()
 
     def _flush(self) -> None:
         """Apply buffered mutations to the canonical host arrays (in-place row
@@ -561,7 +799,8 @@ class TpuDepsResolver(DepsResolver):
         if self._h is None:
             self._rebuild()
             return
-        if not (self._dirty_txns or self._clear_bits or self._deactivate):
+        if not (self._dirty_txns or self._clear_bits or self._deactivate
+                or self._live_ops):
             return
         h = self._h
         # order matters: clears and deactivations target OLD occupants of a
@@ -569,12 +808,14 @@ class TpuDepsResolver(DepsResolver):
         for row, col in self._clear_bits:
             h["key_inc"][row, col] = 0
             h["key_inc_f32"][col, row] = 0.0
+            h["live_f32"][col, row] = 0.0
         self._clear_bits.clear()
         if self._deactivate:
             d = np.asarray(self._deactivate, dtype=np.int32)
             h["active"][d] = False
             h["key_inc"][d] = 0
             h["key_inc_f32"][:, d] = 0.0
+            h["live_f32"][:, d] = 0.0
             h["status"][d] = 0
             self._deactivate.clear()
         for tid in sorted(self._dirty_txns):    # deterministic flush order
@@ -582,15 +823,25 @@ class TpuDepsResolver(DepsResolver):
             row = m.slot
             h["key_inc"][row] = 0
             h["key_inc_f32"][:, row] = 0.0
+            h["live_f32"][:, row] = 0.0
             cols = [self.key_slot[rk] for rk in m.keys]
             h["key_inc"][row, cols] = 1
             h["key_inc_f32"][cols, row] = 1.0
+            live_cols = [self.key_slot[rk] for rk in m.keys - m.covered]
+            h["live_f32"][live_cols, row] = 1.0
             h["ts"][row] = m.execute_at.pack_lanes()
             h["txn_id"][row] = tid.pack_lanes()
             h["kind"][row] = m.kind_code
             h["status"][row] = m.status
             h["active"][row] = True
         self._dirty_txns.clear()
+        # chronological cover/uncover flips: rows written above already carry
+        # the final covered state, so replaying (whose last op per incidence
+        # IS the final state) is consistent; flips on un-dirty rows apply here
+        for row, col, val in self._live_ops:
+            if h["key_inc"][row, col]:      # incidence may have pruned since
+                h["live_f32"][col, row] = float(val)
+        self._live_ops.clear()
         self._device_clean = False
 
     # -- introspection (tests / bench) ---------------------------------------
@@ -617,7 +868,8 @@ def _calibrate_threshold() -> float:
         import jax.numpy as jnp
         from ..ops import deps_kernels as dk
         t, k, b = 256, 64, 8
-        args = (jnp.zeros((t, k), jnp.int8), jnp.zeros((t, TS_LANES), jnp.int32),
+        args = (jnp.zeros((t, k), jnp.int8), jnp.zeros((t, k), jnp.int8),
+                jnp.zeros((t, TS_LANES), jnp.int32),
                 jnp.zeros((t, TS_LANES), jnp.int32), jnp.zeros((t,), jnp.int8),
                 jnp.zeros((t,), jnp.int8), jnp.zeros((t,), jnp.bool_),
                 jnp.zeros((b, k), jnp.int8), jnp.zeros((b, TS_LANES), jnp.int32),

@@ -6,10 +6,20 @@ the key, used for (a) dependency calculation at PreAccept/Accept (``map_reduce_a
 and (b) driving execution order of key-domain reads/writes it manages.
 
 Representation notes vs the reference: the reference packs TxnInfo into sorted arrays
-with deps-by-omission encoding (divergences in ``missing[]``) and transitive elision.
-Round 1 keeps an explicit sorted list of TxnInfo entries with full correctness
-semantics; the deps-by-omission compression and the TPU batched index
-(ops.deps_kernels) slot in behind the same interface.
+with deps-by-omission encoding (divergences in ``missing[]``); this module keeps an
+explicit sorted list of TxnInfo entries behind the same interface, with the
+accelerator index (impl/tpu_resolver.py) as the batched data plane.
+
+TRANSITIVE DEPENDENCY ELISION (CommandsForKey.java:144-157, mapReduceActive
+:925-986): the deps query first establishes the latest committed WRITE whose
+executeAt precedes the query bound — every committed txn executing before that
+write, and witnessed by it, is transitively ordered by it and is elided from
+the answer.  This is what keeps computed deps O(concurrent txns) instead of
+O(key history): the covering write stands in for everything it orders.  The
+recovery-safety argument is the reference's (doc :146-157): both the covering
+write and the elided txn are committed at this replica, so any recovery
+coordinator contacting it learns the agreed outcome directly and never needs
+to decipher a fast-path decision from the elided dependency's presence.
 """
 from __future__ import annotations
 
@@ -72,7 +82,7 @@ class CommandsForKey:
     """Mutable per-key index (the safe/command-store layer guards all access)."""
 
     __slots__ = ("key", "by_id", "prune_before", "_max_applied_write",
-                 "_unmanaged_waiting")
+                 "_unmanaged_waiting", "_committed_writes")
 
     def __init__(self, key: RoutingKey):
         self.key = key
@@ -82,6 +92,10 @@ class CommandsForKey:
         # unmanaged (range/syncpoint) txns registered to be notified when the key's
         # managed txns up to a bound have applied: list of (wait_until_ts, txn_id)
         self._unmanaged_waiting: List[tuple] = []
+        # committed-or-later WRITEs sorted by executeAt (fixed at commit) —
+        # the covering-write index for transitive elision (the reference's
+        # committedByExecuteAt restricted to writes, CommandsForKey.java:929-944)
+        self._committed_writes: List[tuple] = []    # (execute_at, txn_id)
 
     # -- lookup -------------------------------------------------------------
     def get(self, txn_id: TxnId) -> Optional[TxnInfo]:
@@ -126,14 +140,20 @@ class CommandsForKey:
         if found:
             info = self.by_id[i]
             if status > info.status:
+                was = info.status
                 info.status = status
-                if execute_at is not None:
+                # executeAt is FINAL from COMMITTED on (the reference's
+                # TxnInfo/committedByExecuteAt invariant): only the upgrade
+                # that enters the committed lattice may (re)set it
+                if execute_at is not None and was < InternalStatus.COMMITTED:
                     info.execute_at = execute_at
+                self._maybe_index_committed_write(info, was)
             elif (status == info.status and execute_at is not None
                   and status is InternalStatus.ACCEPTED):
                 info.execute_at = execute_at
         else:
             self.by_id.insert(i, probe)
+            self._maybe_index_committed_write(probe, None)
         if status is InternalStatus.APPLIED and txn_id.is_write:
             ea = execute_at if execute_at is not None else txn_id
             if self._max_applied_write is None or ea > self._max_applied_write:
@@ -144,18 +164,43 @@ class CommandsForKey:
         if self.get(txn_id) is None:
             self.update(txn_id, InternalStatus.TRANSITIVELY_KNOWN)
 
+    def _maybe_index_committed_write(self, info: TxnInfo,
+                                     was: Optional[InternalStatus]) -> None:
+        """Track a WRITE's entry into the committed lattice (executeAt is final
+        from COMMITTED on, so the by-executeAt position never moves)."""
+        if info.txn_id.is_write \
+                and info.status in _DECIDED \
+                and (was is None or was < InternalStatus.COMMITTED):
+            insort(self._committed_writes, (info.execute_at, info.txn_id))
+
+    def max_committed_write_before(self, before: Timestamp) -> Optional[Timestamp]:
+        """ExecuteAt of the latest committed WRITE executing strictly before
+        ``before`` — the covering write for transitive elision
+        (CommandsForKey.java:929-944)."""
+        i = bisect_left(self._committed_writes, (before,)) - 1
+        return self._committed_writes[i][0] if i >= 0 else None
+
     # -- dependency calculation (the HOT query; CommandsForKey.java:925-1000) ----
     def map_reduce_active(self, before: Timestamp, witnesses: Callable[[TxnId], bool],
                           fn: Callable[[TxnId], None]) -> None:
-        """Visit every active (not invalidated) managed txn with txnId < before that
-        the caller's kind witnesses.  This is the PreAccept/Accept deps query."""
+        """Visit every active managed txn with txnId < before that the caller's
+        kind witnesses — MINUS committed txns transitively covered by the
+        latest committed write executing before the bound (elision, module
+        doc).  This is the PreAccept/Accept deps query."""
+        maxcw = self.max_committed_write_before(before)
         for info in self.by_id:
             if info.txn_id >= before:
                 break
-            if info.status is InternalStatus.INVALIDATED:
+            st = info.status
+            if st is InternalStatus.INVALIDATED \
+                    or st is InternalStatus.TRANSITIVELY_KNOWN:
                 continue
             if not witnesses(info.txn_id):
                 continue
+            if maxcw is not None and st in _DECIDED \
+                    and info.execute_at < maxcw \
+                    and TxnKind.WRITE.witnesses(info.txn_id.kind):
+                continue    # ordered (and witnessed) by the covering write
             fn(info.txn_id)
 
     def map_reduce_full(self, fn: Callable[[TxnInfo], None]) -> None:
@@ -244,6 +289,9 @@ class CommandsForKey:
         if pruned:
             self.by_id = keep
             self.prune_before = highest
+            gone = set(pruned)
+            self._committed_writes = [e for e in self._committed_writes
+                                      if e[1] not in gone]
         return pruned
 
     def maybe_prune(self, prune_before_hlc_delta: int) -> List[TxnId]:

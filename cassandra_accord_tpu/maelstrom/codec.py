@@ -19,7 +19,9 @@ from typing import Any, Dict, Tuple, Type
 import numpy as np
 
 _CLASSES: Dict[str, Tuple[Type, Tuple[str, ...]]] = {}
-_SKIP_SLOTS = {"_inverted"}   # lazily-rebuilt caches
+# derived caches: never on the wire; rebuilt at decode (None for the lazy
+# ones, __wire_rebuild__ for the eager ones like Timestamp._k)
+_SKIP_SLOTS = {"_inverted", "_k", "_kind_c"}
 
 
 def _all_slots(cls: Type) -> Tuple[str, ...]:
@@ -93,8 +95,9 @@ def _register_all() -> None:
             if cls is not None:
                 register(cls)
 
+    from ..local.cfk import InternalStatus
     for e in (t.TxnKind, t.Domain, SaveStatus, Status, Durability,
-              C.AcceptOutcome, C.CommitOutcome):
+              C.AcceptOutcome, C.CommitOutcome, InternalStatus):
         _CLASSES[e.__name__] = (e, ())
 
     # ReducingIntervalMap + DurableEntry/RedundantEntry (NamedTuples)
@@ -104,11 +107,13 @@ def _register_all() -> None:
 
 
 def encode_value(obj: Any):
+    if isinstance(obj, enum.Enum):
+        # BEFORE the primitive branch: IntEnums (TxnKind, InternalStatus) are
+        # ints and would otherwise lose their type on the wire.  By NAME:
+        # enum values may be arbitrary tuples (SaveStatus ordinal+status)
+        return {"$": type(obj).__name__, "v": obj.name, "e": 1}
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
-    if isinstance(obj, enum.Enum):
-        # by NAME: enum values may be arbitrary tuples (SaveStatus ordinal+status)
-        return {"$": type(obj).__name__, "v": obj.name, "e": 1}
     if isinstance(obj, np.ndarray):
         return {"$": "nd", "dt": str(obj.dtype), "v": obj.tolist()}
     if isinstance(obj, np.integer):
@@ -177,6 +182,9 @@ def decode_value(obj: Any):
                 setattr(inst, s, None)
             except AttributeError:
                 pass
+    rebuild = getattr(inst, "__wire_rebuild__", None)
+    if rebuild is not None:
+        rebuild()
     return inst
 
 

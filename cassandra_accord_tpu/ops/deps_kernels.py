@@ -156,7 +156,8 @@ def max_conflict_keys(index_key_inc: jax.Array,  # [T, K] int8
 
 
 @jax.jit
-def consult(index_key_inc: jax.Array,   # [T, K] int8
+def consult(index_live_inc: jax.Array,  # [T, K] int8 — covered bits zeroed
+            index_key_inc: jax.Array,   # [T, K] int8 — full incidence
             index_ts: jax.Array,        # [T, 5] int32 executeAt
             index_txn_id: jax.Array,    # [T, 5] int32
             index_kind: jax.Array,      # [T] int8
@@ -169,20 +170,27 @@ def consult(index_key_inc: jax.Array,   # [T, K] int8
     """The fused replica consult: one launch answers BOTH halves of a
     PreAccept-class query batch — the dependency calculation
     (mapReduceActive / overlap_join) and the timestamp-proposal max
-    (MaxConflicts / max_conflict_keys) — sharing the single key-overlap
-    matmul between them.  This is the per-message device round-trip
-    collapsed to one, and with B > 1 it is the whole delivery window's
-    deps traffic in one MXU dispatch.
+    (MaxConflicts / max_conflict_keys).  This is the per-message device
+    round-trip collapsed to one, and with B > 1 it is the whole delivery
+    window's deps traffic in one MXU dispatch.
+
+    The deps join runs over the LIVE incidence — the full matrix minus
+    per-incidence covered bits, which implement cfk transitive elision
+    (CommandsForKey.java:144-157) for bounds above the per-key covering
+    bound (the caller routes other bounds to the exact per-key path).  The
+    timestamp-proposal max runs over the FULL incidence: elision never
+    applies to MaxConflicts.
 
     Returns (deps [B, T] bool, max_lanes [B, 5] int32)."""
-    share_key = _bool_matmul(batch_key_inc, index_key_inc.T)             # [B, T]
+    share_live = _bool_matmul(batch_key_inc, index_live_inc.T)           # [B, T]
     started_before = ts_less(index_txn_id[None, :, :],
                              batch_before[:, None, :])                   # [B, T]
     witnesses = WITNESSES[batch_kind[:, None].astype(jnp.int32),
                           index_kind[None, :].astype(jnp.int32)]         # [B, T]
     eligible = index_active & (index_status != INVALIDATED)              # [T]
-    deps = share_key & started_before & witnesses & eligible[None, :]
-    mc_mask = share_key & index_active[None, :]
+    deps = share_live & started_before & witnesses & eligible[None, :]
+    share_full = _bool_matmul(batch_key_inc, index_key_inc.T)            # [B, T]
+    mc_mask = share_full & index_active[None, :]
     per_slot = jnp.where(ts_less(index_ts, index_txn_id)[:, None],
                          index_txn_id, index_ts)                         # [T, 5]
     max_lanes = _lex_max_masked(

@@ -108,7 +108,7 @@ class TxnKind(enum.IntEnum):
 class Timestamp:
     """Totally-ordered HLC timestamp: (epoch, hlc, flags, node)."""
 
-    __slots__ = ("epoch", "hlc", "flags", "node")
+    __slots__ = ("epoch", "hlc", "flags", "node", "_k")
 
     def __init__(self, epoch: int, hlc: int, node: int, flags: int = 0):
         check_argument(0 <= epoch <= MAX_EPOCH, "epoch out of range: %s", epoch)
@@ -118,6 +118,8 @@ class Timestamp:
         self.hlc = hlc
         self.flags = flags
         self.node = node
+        # immutable; the comparison key is on every protocol hot path
+        self._k = (epoch, hlc, flags, node)
 
     # -- constants ----------------------------------------------------------
     NONE: "Timestamp"
@@ -133,29 +135,34 @@ class Timestamp:
 
     # -- ordering -----------------------------------------------------------
     def _key(self) -> Tuple[int, int, int, int]:
-        return (self.epoch, self.hlc, self.flags, self.node)
+        return self._k
 
     def __lt__(self, other: "Timestamp") -> bool:
-        return self._key() < other._key()
+        return self._k < other._k
 
     def __le__(self, other: "Timestamp") -> bool:
-        return self._key() <= other._key()
+        return self._k <= other._k
 
     def __gt__(self, other: "Timestamp") -> bool:
-        return self._key() > other._key()
+        return self._k > other._k
 
     def __ge__(self, other: "Timestamp") -> bool:
-        return self._key() >= other._key()
+        return self._k >= other._k
 
     def __eq__(self, other) -> bool:
-        return isinstance(other, Timestamp) and self._key() == other._key()
+        return isinstance(other, Timestamp) and self._k == other._k
 
     def __hash__(self) -> int:
-        return hash(self._key())
+        return hash(self._k)
 
     def compare_to(self, other: "Timestamp") -> int:
-        a, b = self._key(), other._key()
+        a, b = self._k, other._k
         return -1 if a < b else (1 if a > b else 0)
+
+    def __wire_rebuild__(self) -> None:
+        """Recompute derived caches after slot-wise decode (maelstrom codec
+        skips them on the wire)."""
+        self._k = (self.epoch, self.hlc, self.flags, self.node)
 
     # -- flags --------------------------------------------------------------
     @property
@@ -228,17 +235,22 @@ _DOMAIN_SHIFT = 1
 class TxnId(Timestamp):
     """A Timestamp whose identity flags carry (Txn.Kind, Routable.Domain)."""
 
-    __slots__ = ()
+    __slots__ = ("_kind_c",)
 
     def __init__(self, epoch: int, hlc: int, node: int,
                  kind: TxnKind = TxnKind.WRITE, domain: Domain = Domain.KEY,
                  extra_flags: int = 0):
         flags = (extra_flags & ~0x1E) | (int(kind) << _KIND_SHIFT) | (int(domain) << _DOMAIN_SHIFT)
         super().__init__(epoch, hlc, node, flags)
+        self._kind_c = TxnKind((flags >> _KIND_SHIFT) & 0x7)
 
     @property
     def kind(self) -> TxnKind:
-        return TxnKind((self.flags >> _KIND_SHIFT) & 0x7)
+        return self._kind_c
+
+    def __wire_rebuild__(self) -> None:
+        super().__wire_rebuild__()
+        self._kind_c = TxnKind((self.flags >> _KIND_SHIFT) & 0x7)
 
     @property
     def domain(self) -> Domain:
@@ -276,6 +288,7 @@ class TxnId(Timestamp):
     def _rebuild(cls, src: "TxnId", flags: int) -> "TxnId":
         t = TxnId.__new__(TxnId)
         Timestamp.__init__(t, src.epoch, src.hlc, src.node, flags)
+        t._kind_c = TxnKind((flags >> _KIND_SHIFT) & 0x7)
         return t
 
     @staticmethod

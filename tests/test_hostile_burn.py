@@ -55,9 +55,10 @@ def test_chaos_without_recovery_stalls():
                  progress_log=False, max_tasks=1_000_000)
 
 
-def test_hostile_burn_verifies_resolver_parity():
+def test_hostile_burn_verifies_resolver_parity(monkeypatch):
     """Hostile burn with the verify resolver: every deps query answered by both
     the CPU walk and the TPU data plane, asserted equal."""
+    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")   # exercise vector tiers
     result = run_burn(5, ops=40, concurrency=8, chaos=True, allow_failures=True,
                       durability=True, resolver="verify", max_tasks=3_000_000)
     assert result.resolved == 40

@@ -52,6 +52,7 @@ def make_pair():
     store = _FakeStore()
     cpu = CpuDepsResolver(store)
     tpu = TpuDepsResolver(store, txn_capacity=4, key_capacity=4)  # force growth
+    tpu._walk_max = 0   # keep the vector tiers under test (not the walk rung)
     return store, VerifyDepsResolver(cpu, tpu)
 
 
@@ -175,8 +176,9 @@ def test_witness_matrix_parity():
     assert {t for _, t in got_w} == {w, r}
 
 
-def test_cluster_end_to_end_verify_resolver():
+def test_cluster_end_to_end_verify_resolver(monkeypatch):
     """A full simulated-cluster run with the parity-asserting resolver."""
+    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")   # exercise vector tiers
     shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
     cluster = Cluster(Topology(1, shards), seed=77, resolver="verify")
     results = []
@@ -198,8 +200,9 @@ def test_cluster_end_to_end_verify_resolver():
     assert total > 50, f"only {total} parity-checked queries"
 
 
-def test_burn_with_verify_resolver():
+def test_burn_with_verify_resolver(monkeypatch):
     """Seeded burn (topology churn + journal) under continuous deps parity."""
+    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")   # exercise vector tiers
     result = run_burn(seed=424242, ops=80, concurrency=8, topology_churn=True,
                       journal=True, resolver="verify")
     assert result.ops_ok > 0
@@ -289,10 +292,46 @@ def test_prefetch_accept_on_fresh_replica():
     assert {t for _, t in got} == {a, b}   # parity-asserted vs the cfk walk
 
 
-def test_cluster_batch_window_parity():
+def test_live_ops_not_replayed_on_recycled_slot():
+    """Buffered cover/uncover ops must die with their slot: a new occupant of
+    a recycled slot must not inherit a stale covered bit (which would drop it
+    from deps answers — a missing-dependency serializability hazard)."""
+    store, verify = make_pair()
+    w1, a = tid(10), tid(20)
+    register_both(store, verify, w1, InternalStatus.PREACCEPTED, None, [rk(0)])
+    register_both(store, verify, w1, InternalStatus.COMMITTED,
+                  Timestamp(1, 100, 0, 1), [rk(0)])
+    # a commits below the covering bound -> covered (live op buffered, no
+    # query in between so nothing flushes it)
+    register_both(store, verify, a, InternalStatus.PREACCEPTED, None, [rk(0)])
+    register_both(store, verify, a, InternalStatus.COMMITTED,
+                  Timestamp(1, 50, 0, 1), [rk(0)])
+    register_both(store, verify, a, InternalStatus.APPLIED, None, [rk(0)])
+    verify.on_pruned(rk(0), store.cfks[rk(0)].prune_applied_before(tid(25)))
+    # b recycles a's slot on the same key
+    b = tid(30, node=2)
+    register_both(store, verify, b, InternalStatus.PREACCEPTED, None, [rk(0)])
+    q = tid(40)
+    got = verify.key_conflicts(q, [rk(0)], q.as_timestamp())
+    assert {t for _, t in got} == {w1, b}   # parity-asserted; b must survive
+
+
+def test_txnid_rebuild_keeps_kind():
+    """TxnId flag-rebuild paths (merge_max, with_rejected) must preserve the
+    kind cache."""
+    a = tid(10, kind=TxnKind.READ)
+    b = tid(10, kind=TxnKind.READ)
+    merged = a.merge_max(b.with_rejected())
+    assert merged.kind is TxnKind.READ
+    assert merged.is_rejected
+    assert a.with_rejected().kind is TxnKind.READ
+
+
+def test_cluster_batch_window_parity(monkeypatch):
     """Delivery-window coalescing under the parity-asserting resolver: the
     batched/prefetched fast path must agree with the cfk walk on every query,
     and actually hit."""
+    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")   # exercise vector tiers
     shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
     cluster = Cluster(Topology(1, shards), seed=99, resolver="verify",
                       batch_window_us=2_000)
