@@ -1,0 +1,61 @@
+"""CollectDeps — quorum deps collection over a footprint.
+
+Capability parity with ``accord.coordinate.CollectDeps`` (CollectDeps.java):
+drive a GetDeps round to a quorum of every shard covering ``keys`` and merge
+the replies.  Used by recovery when the merged commit evidence is
+insufficient for part of the footprint (Recover.withCommittedDeps,
+Recover.java:384-400).
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING, Dict
+
+from ..messages.base import Callback, TxnRequest
+from ..messages.deps_messages import GetDeps, GetDepsOk
+from ..primitives.deps import Deps
+from ..primitives.route import Route
+from ..primitives.timestamp import Timestamp, TxnId
+from ..utils import async_ as au
+from .errors import Exhausted
+from .tracking import QuorumTracker, RequestStatus
+
+if TYPE_CHECKING:
+    from ..local.node import Node
+
+
+def collect_deps(node: "Node", txn_id: TxnId, route: Route, keys,
+                 execute_at: Timestamp) -> au.AsyncResult:
+    """Resolve with the merged Deps for ``keys`` at ``execute_at``."""
+    result = au.settable()
+    topologies = node.topology.precise_epochs(route, txn_id.epoch,
+                                              execute_at.epoch)
+    tracker = QuorumTracker(topologies)
+    oks: Dict[int, Deps] = {}
+    state = {"done": False}
+
+    class CollectCallback(Callback):
+        def on_success(self, from_node: int, reply) -> None:
+            if state["done"]:
+                return
+            if isinstance(reply, GetDepsOk):
+                oks[from_node] = reply.deps
+                if tracker.record_success(from_node) is RequestStatus.SUCCESS:
+                    state["done"] = True
+                    result.set_success(Deps.merge(list(oks.values())))
+
+        def on_failure(self, from_node: int, failure: BaseException) -> None:
+            if state["done"]:
+                return
+            if tracker.record_failure(from_node) is RequestStatus.FAILED:
+                state["done"] = True
+                result.set_failure(Exhausted(txn_id, "GetDeps quorum unreachable"))
+
+    callback = CollectCallback()
+    for to in tracker.nodes():
+        scope = TxnRequest.compute_scope(to, topologies, route)
+        if scope is None:
+            continue
+        node.send(to, GetDeps(txn_id, scope,
+                              TxnRequest.compute_wait_for_epoch(to, topologies),
+                              keys, execute_at), callback)
+    return result

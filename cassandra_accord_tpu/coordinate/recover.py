@@ -33,6 +33,8 @@ from ..messages.recovery_messages import (
 )
 from ..local.status import Phase, Status
 from ..primitives.deps import Deps
+from ..primitives.keys import Ranges
+from ..primitives.latest_deps import LatestDeps
 from ..primitives.route import Route
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import Txn
@@ -120,7 +122,7 @@ class _Recover:
     def analyse(self) -> None:
         oks = list(self.oks.values())
         best = max_accepted_reply(oks)
-        merged_deps = Deps.merge([ok.deps for ok in oks])
+        latest = LatestDeps.merge_all([ok.deps for ok in oks])
 
         if best is not None:
             status, execute_at = best.status, best.execute_at
@@ -128,21 +130,29 @@ class _Recover:
                 self.commit_invalidate()
                 return
             if status.has_been(Status.PRE_APPLIED):
-                self.persist_known_outcome(execute_at, merged_deps)
+                self.persist_known_outcome(execute_at, latest)
                 return
             if status.has_been(Status.STABLE) or status.has_been(Status.PRE_COMMITTED):
-                # executeAt decided: (re-)stabilise at it, then execute.
-                # deps: superset of any committed deps is safe — extra deps only
-                # add waits, and waits resolve in executeAt order.
+                # executeAt decided: (re-)stabilise at it with the PHASE-AWARE
+                # deps merge (LatestDeps.mergeCommit): committed-grade ranges
+                # use the decided deps; fast-path ranges may substitute local
+                # calculations; anything else is fetched via GetDeps.  Claim
+                # ``done`` NOW: a straggler nack arriving during the async
+                # GetDeps round must not settle the result out from under the
+                # stabilisation this branch has committed to
                 self.done = True
-                resume_stabilise(self.node, self.txn_id, self.txn, self.route,
-                                 self.result, self.ballot, execute_at, merged_deps)
-                self._on_settled()
+
+                def stabilise_with(deps: Deps) -> None:
+                    resume_stabilise(self.node, self.txn_id, self.txn, self.route,
+                                     self.result, self.ballot, execute_at, deps)
+                    self._on_settled()
+                self.with_committed_deps(execute_at, latest, stabilise_with)
                 return
             if status is Status.ACCEPTED:
                 self.done = True
                 resume_propose(self.node, self.txn_id, self.txn, self.route,
-                               self.result, self.ballot, execute_at, merged_deps)
+                               self.result, self.ballot, execute_at,
+                               latest.merge_proposal())
                 self._on_settled()
                 return
             if status is Status.ACCEPTED_INVALIDATE:
@@ -167,10 +177,41 @@ class _Recover:
         # the fast path may have committed: complete it at executeAt = txnId
         self.done = True
         resume_propose(self.node, self.txn_id, self.txn, self.route, self.result,
-                       self.ballot, self.txn_id.as_timestamp(), merged_deps)
+                       self.ballot, self.txn_id.as_timestamp(),
+                       latest.merge_proposal())
         self._on_settled()
 
-    def persist_known_outcome(self, execute_at: Timestamp, merged_deps: Deps) -> None:
+    def with_committed_deps(self, execute_at: Timestamp, latest: LatestDeps,
+                            use_deps) -> None:
+        """Phase-aware commit deps (Recover.withCommittedDeps,
+        Recover.java:384-400): merge the quorum's evidence per range; any part
+        of the footprint the merge is insufficient for is collected fresh via
+        a GetDeps round at executeAt.  Callers have already claimed ``done``,
+        so failures settle the result DIRECTLY (the progress log retries) —
+        routing them through fail() would drop them on the floor."""
+        deps, sufficient = latest.merge_commit(self.txn_id, execute_at)
+        missing = [key for key in self.txn.keys
+                   if not sufficient.contains(
+                       key.to_routing() if hasattr(key, "to_routing") else key)] \
+            if not isinstance(self.txn.keys, Ranges) \
+            else self.txn.keys.without(sufficient)
+        if (isinstance(missing, Ranges) and missing.is_empty()) or not missing:
+            use_deps(deps)
+            return
+        this = self
+        from .collect_deps import collect_deps
+
+        def on_collected(extra, failure):
+            if failure is not None:
+                this.result.set_failure(failure)
+                return
+            use_deps(deps.with_merged(extra))
+
+        collect_deps(self.node, self.txn_id, self.route, missing,
+                     execute_at).add_listener(on_collected)
+
+    def persist_known_outcome(self, execute_at: Timestamp,
+                              latest: LatestDeps) -> None:
         """Some replica applied the txn: assemble the COMPLETE outcome before
         re-disseminating it.  A single RecoverOk's writes are that replica's
         per-shard SLICE — persisting a slice as if it were the whole write-set
@@ -178,7 +219,8 @@ class _Recover:
         (the divergence class the hostile burn caught).  Fetch the outcome over
         the full route (slice-union + applied_for coverage check,
         CheckStatusOk.merge); if the union does not yet cover the footprint,
-        fall back to re-stabilise/execute at the known executeAt."""
+        fall back to re-stabilise/execute at the known executeAt with
+        phase-aware merged deps."""
         this = self
         self.done = True
         from .fetch_data import fetch_data
@@ -190,20 +232,21 @@ class _Recover:
             parts = this.route.participants()
             if merged is not None and merged.writes is not None \
                     and merged.execute_at is not None \
-                    and merged.applied_for.contains_all(parts):
-                deps = merged.partial_deps \
-                    if merged.partial_deps is not None \
-                    and merged.stable_for.contains_all(parts) else merged_deps
+                    and merged.applied_for.contains_all(parts) \
+                    and merged.partial_deps is not None \
+                    and merged.stable_for.contains_all(parts):
                 persist_maximal(this.node, this.txn_id, this.txn, this.route,
-                                this.topologies, merged.execute_at, deps,
-                                merged.writes, merged.result)
+                                this.topologies, merged.execute_at,
+                                merged.partial_deps, merged.writes, merged.result)
                 this.node.agent.metrics_events_listener().on_recover(
                     this.txn_id, this.ballot)
                 this.result.set_success(merged.result)
             else:
-                resume_stabilise(this.node, this.txn_id, this.txn, this.route,
-                                 this.result, this.ballot, execute_at, merged_deps)
-                this._on_settled()
+                def stabilise_with(deps: Deps) -> None:
+                    resume_stabilise(this.node, this.txn_id, this.txn, this.route,
+                                     this.result, this.ballot, execute_at, deps)
+                    this._on_settled()
+                this.with_committed_deps(execute_at, latest, stabilise_with)
 
         fetch_data(self.node, self.txn_id, self.route).add_listener(on_fetched)
 

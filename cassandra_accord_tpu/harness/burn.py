@@ -205,6 +205,13 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             ss = merged.save_status if merged is not None else SaveStatus.NOT_DEFINED
             if ss is SaveStatus.INVALIDATED:
                 resolve(obs, "nacked", writes=writes)
+            elif merged is not None and merged.invalid_if_undecided \
+                    and not ss.has_been(Status.PRE_COMMITTED):
+                # Infer (Infer.java IfUndecided with quorum): every quorum
+                # member's majority-durability watermark passed txnId and none
+                # saw a decision — the txn provably never committed and never
+                # can (preaccept below the fence refuses): durably invalid
+                resolve(obs, "nacked", writes=writes)
             elif ss.ordinal >= SaveStatus.APPLIED.ordinal and not ss.is_truncated:
                 reads = dict(merged.result.reads) \
                     if isinstance(merged.result, ListResult) else {}

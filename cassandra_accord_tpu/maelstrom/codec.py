@@ -46,6 +46,7 @@ def _register_all() -> None:
     from ..local.status import Durability, SaveStatus, Status
     from ..local import commands as C
     from ..messages import base as mb
+    from ..messages import deps_messages as gdm
     from ..messages import durability_messages as dm
     from ..messages import ephemeral_messages as em
     from ..messages import fetch_messages as fm
@@ -54,6 +55,7 @@ def _register_all() -> None:
     from ..messages import txn_messages as tm
     from ..primitives import deps as d
     from ..primitives import keys as k
+    from ..primitives import latest_deps as ld
     from ..primitives import route as r
     from ..primitives import sync_point as spp
     from ..primitives import timestamp as t
@@ -66,6 +68,8 @@ def _register_all() -> None:
              "RoutingKeys", "Ranges"]),
         (r, ["Route"]),
         (d, ["KeyDeps", "RangeDeps", "Deps"]),
+        (ld, ["LatestDeps", "LatestEntry"]),
+        (gdm, ["GetDeps", "GetDepsOk"]),
         (tx, ["Txn", "PartialTxn", "Writes"]),
         (spp, ["SyncPoint"]),
         (ls, ["ListRead", "ListRangeRead", "ListUpdate", "ListWrite",
@@ -96,8 +100,9 @@ def _register_all() -> None:
                 register(cls)
 
     from ..local.cfk import InternalStatus
+    from ..primitives.latest_deps import KnownDeps
     for e in (t.TxnKind, t.Domain, SaveStatus, Status, Durability,
-              C.AcceptOutcome, C.CommitOutcome, InternalStatus):
+              C.AcceptOutcome, C.CommitOutcome, InternalStatus, KnownDeps):
         _CLASSES[e.__name__] = (e, ())
 
     # ReducingIntervalMap + DurableEntry/RedundantEntry (NamedTuples)

@@ -25,6 +25,7 @@ from ..local.command_store import SafeCommandStore
 from ..local.status import Phase, SaveStatus, Status
 from ..primitives.deps import Deps, DepsBuilder
 from ..primitives.keys import Ranges
+from ..primitives.latest_deps import KnownDeps, LatestDeps
 from ..primitives.route import Route
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import PartialTxn
@@ -46,7 +47,7 @@ class RecoverOk(Reply):
                  "rejects_fast_path", "writes", "result")
 
     def __init__(self, txn_id: TxnId, status: Status, accepted: Ballot,
-                 execute_at: Optional[Timestamp], deps: Deps,
+                 execute_at: Optional[Timestamp], deps: LatestDeps,
                  earlier_committed_witness: Deps, earlier_accepted_no_witness: Deps,
                  rejects_fast_path: bool, writes, result):
         self.txn_id = txn_id
@@ -66,8 +67,8 @@ class RecoverOk(Reply):
 
     def merge(self, other: "RecoverOk") -> "RecoverOk":
         """Merge two per-store/per-node replies (BeginRecovery.reduce): keep the
-        evidence of the max (phase, ballot-within-Accept-phase) reply, union the
-        deps and the earlier-witness sets."""
+        evidence of the max (phase, ballot-within-Accept-phase) reply, merge the
+        LatestDeps maps per range by phase, union the earlier-witness sets."""
         a, b = self, other
         if _reply_order_key(b) > _reply_order_key(a):
             a, b = b, a
@@ -81,7 +82,7 @@ class RecoverOk(Reply):
         else:
             execute_at = a.execute_at
         return RecoverOk(a.txn_id, a.status, a.accepted, execute_at,
-                         a.deps.with_merged(b.deps), ecw, eanw,
+                         a.deps.merge(b.deps), ecw, eanw,
                          a.rejects_fast_path or b.rejects_fast_path,
                          a.writes, b.result if a.result is None else a.result)
 
@@ -254,11 +255,25 @@ class BeginRecovery(TxnRequest):
             if outcome is C.AcceptOutcome.REJECTED_BALLOT:
                 return RecoverNack(safe_store.get_if_exists(txn_id).promised)
             command = safe_store.get_if_exists(txn_id)
-            if command.has_been(Status.ACCEPTED) and command.partial_deps is not None:
-                deps = command.partial_deps
+            # phase-aware deps evidence (BeginRecovery.java:95-121): the
+            # coordinator-held deps at their knowledge phase, plus a fresh
+            # local calculation while no committed/decided deps exist
+            coordinated = command.partial_deps
+            if command.has_been(Status.STABLE):
+                known = KnownDeps.KNOWN
+            elif command.has_been(Status.COMMITTED):
+                known = KnownDeps.COMMITTED
+            elif command.status is Status.ACCEPTED and coordinated is not None:
+                known = KnownDeps.PROPOSED
             else:
-                deps = calculate_partial_deps(safe_store, txn_id, partial_txn.keys,
-                                              txn_id.as_timestamp())
+                known = KnownDeps.UNKNOWN   # incl. PreCommitted/AcceptedInvalidate
+            local = None
+            if known <= KnownDeps.PROPOSED:
+                local = calculate_partial_deps(safe_store, txn_id, partial_txn.keys,
+                                               txn_id.as_timestamp())
+            deps = LatestDeps.create(
+                safe_store.store.ranges_at(txn_id.epoch),
+                known, command.accepted_or_committed, coordinated, local)
             if command.has_been(Status.PRE_COMMITTED):
                 rejects, ecw, eanw = False, Deps.NONE, Deps.NONE
             else:

@@ -33,14 +33,15 @@ class CheckStatusOk(Reply):
 
     __slots__ = ("txn_id", "save_status", "promised", "accepted", "execute_at",
                  "durability", "route", "partial_txn", "partial_deps", "writes",
-                 "result", "stable_for", "applied_for")
+                 "result", "stable_for", "applied_for", "invalid_if_undecided")
 
     def __init__(self, txn_id: TxnId, save_status: SaveStatus, promised: Ballot,
                  accepted: Ballot, execute_at: Optional[Timestamp],
                  durability: Durability, route: Optional[Route],
                  partial_txn: Optional[PartialTxn], partial_deps: Optional[Deps],
                  writes: Optional[Writes], result,
-                 stable_for=None, applied_for=None):
+                 stable_for=None, applied_for=None,
+                 invalid_if_undecided: bool = False):
         from ..primitives.keys import Ranges
         self.txn_id = txn_id
         self.save_status = save_status
@@ -58,6 +59,12 @@ class CheckStatusOk(Reply):
         # (>= STABLE) and writes (>= PRE_APPLIED) slices are known-complete
         self.stable_for = stable_for if stable_for is not None else Ranges.EMPTY
         self.applied_for = applied_for if applied_for is not None else Ranges.EMPTY
+        # Infer hint (Infer.InvalidIfNot.IfUndecided, Infer.java:63-186): this
+        # replica's majority-durability watermark passed txnId on its queried
+        # ranges, so every lower txn is either durably applied or invalidated —
+        # if a quorum says so and the txn is still undecided, it provably never
+        # committed and can never commit (preaccept below the fence refuses)
+        self.invalid_if_undecided = invalid_if_undecided
 
     @property
     def type(self):
@@ -82,6 +89,22 @@ class CheckStatusOk(Reply):
                              command.durability, command.route, command.partial_txn,
                              command.partial_deps, command.writes, command.result,
                              stable_for=stable_for, applied_for=applied_for)
+
+    @staticmethod
+    def infer_invalid_hint(safe_store, txn_id: TxnId, command) -> bool:
+        """IfUndecided inference grounds (Infer.withInvalidIfNot,
+        Infer.java:327-378): the store's majority-durability watermark covers
+        txnId on every locally-owned participant — meaningless (False) once the
+        command is decided locally."""
+        from ..local.status import Status as S
+        if command is not None and command.has_been(S.PRE_COMMITTED):
+            return False
+        local = safe_store.current_ranges()
+        if not len(local):
+            return False
+        from ..local.status import Durability as D
+        return safe_store.durable_before().min_durability(
+            txn_id, local) >= D.MAJORITY
 
     @staticmethod
     def empty(txn_id: TxnId) -> "CheckStatusOk":
@@ -127,7 +150,10 @@ class CheckStatusOk(Reply):
             writes,
             a.result if a.result is not None else b.result,
             stable_for=a.stable_for.union(b.stable_for),
-            applied_for=a.applied_for.union(b.applied_for))
+            applied_for=a.applied_for.union(b.applied_for),
+            # AND: the inference claim must hold at every contributor
+            # (Infer.InvalidIfNot.reduce takes the weaker side)
+            invalid_if_undecided=a.invalid_if_undecided and b.invalid_if_undecided)
 
     def full_txn(self) -> Optional[Txn]:
         """Reconstitute the complete txn if the merged partials cover the route."""
@@ -160,9 +186,13 @@ class CheckStatus(TxnRequest):
 
         def map_fn(safe_store: SafeCommandStore):
             command = safe_store.get_if_exists(txn_id)
+            hint = CheckStatusOk.infer_invalid_hint(safe_store, txn_id, command)
             if command is None:
-                return CheckStatusOk.empty(txn_id)
+                ok = CheckStatusOk.empty(txn_id)
+                ok.invalid_if_undecided = hint
+                return ok
             ok = CheckStatusOk.of(txn_id, command, safe_store.current_ranges())
+            ok.invalid_if_undecided = hint
             if not include_info:
                 from ..primitives.keys import Ranges
                 ok.partial_txn = None

@@ -1,0 +1,124 @@
+"""LatestDeps — phase-aware per-range recovery deps merge (LatestDeps.java),
+and the GetDeps/CollectDeps round that fills insufficient footprints.
+"""
+from cassandra_accord_tpu.harness.cluster import Cluster
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.primitives.deps import Deps, KeyDeps
+from cassandra_accord_tpu.primitives.keys import IntKey, Range, Ranges
+from cassandra_accord_tpu.primitives.latest_deps import (KnownDeps, LatestDeps,
+                                                         LatestEntry)
+from cassandra_accord_tpu.primitives.timestamp import (Ballot, Domain, Timestamp,
+                                                       TxnId, TxnKind)
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+
+
+def k(v):
+    return IntKey(v)
+
+
+def rk(v):
+    return IntKey(v).to_routing()
+
+
+def tid(hlc, node=1):
+    return TxnId(epoch=1, hlc=hlc, node=node, kind=TxnKind.WRITE, domain=Domain.KEY)
+
+
+def ballot(hlc):
+    return Ballot(1, hlc, 1)
+
+
+def deps_of(*pairs):
+    return Deps(key_deps=KeyDeps.of({rk(kv): ids for kv, ids in pairs}))
+
+
+def rngs(lo, hi):
+    return Ranges.of(Range(k(lo), k(hi)))
+
+
+def test_higher_phase_wins_over_union():
+    """A STABLE range's decided deps must NOT be polluted by another replica's
+    fresh local calculation (which may contain later txns)."""
+    decided = deps_of((5, [tid(10)]))
+    fresh = deps_of((5, [tid(10), tid(99)]))   # saw a later txn locally
+    a = LatestDeps.create(rngs(0, 100), KnownDeps.KNOWN, ballot(1), decided, None)
+    b = LatestDeps.create(rngs(0, 100), KnownDeps.UNKNOWN, Ballot.ZERO, None, fresh)
+    for merged in (a.merge(b), b.merge(a)):
+        deps, sufficient = merged.merge_commit(tid(20), Timestamp(1, 30, 1))
+        assert deps.txn_ids() == [tid(10)]     # tid(99) excluded
+        assert sufficient.contains(rk(5))
+
+
+def test_proposal_ballot_tiebreak_excludes_superseded():
+    """Two Accept-phase proposals: only the max-ballot one feeds a recovery
+    re-proposal (Paxos value adoption, not a union)."""
+    old = deps_of((5, [tid(1)]))
+    new = deps_of((5, [tid(2)]))
+    a = LatestDeps.create(rngs(0, 100), KnownDeps.PROPOSED, ballot(1), old, None)
+    b = LatestDeps.create(rngs(0, 100), KnownDeps.PROPOSED, ballot(2), new, None)
+    for merged in (a.merge(b), b.merge(a)):
+        assert merged.merge_proposal().txn_ids() == [tid(2)]
+
+
+def test_unknown_unions_locals():
+    a = LatestDeps.create(rngs(0, 100), KnownDeps.UNKNOWN, Ballot.ZERO, None,
+                          deps_of((5, [tid(1)])))
+    b = LatestDeps.create(rngs(0, 100), KnownDeps.UNKNOWN, Ballot.ZERO, None,
+                          deps_of((5, [tid(2)])))
+    assert set(a.merge(b).merge_proposal().txn_ids()) == {tid(1), tid(2)}
+
+
+def test_per_range_independence():
+    """Phases merge per range: a KNOWN range and an UNKNOWN range from
+    different replicas keep their own treatment."""
+    a = LatestDeps.create(rngs(0, 50), KnownDeps.KNOWN, ballot(1),
+                          deps_of((5, [tid(1)])), None)
+    b = LatestDeps.create(rngs(50, 100), KnownDeps.UNKNOWN, Ballot.ZERO, None,
+                          deps_of((60, [tid(2)])))
+    merged = a.merge(b)
+    # slow path (executeAt != txnId): only the KNOWN range is sufficient
+    deps, sufficient = merged.merge_commit(tid(20), Timestamp(1, 30, 2))
+    assert deps.txn_ids() == [tid(1)]
+    assert sufficient.contains(rk(5)) and not sufficient.contains(rk(60))
+    # fast path: the UNKNOWN range's locals become usable
+    deps, sufficient = merged.merge_commit(tid(20), tid(20).as_timestamp())
+    assert set(deps.txn_ids()) == {tid(1), tid(2)}
+    assert sufficient.contains(rk(60))
+
+
+def test_deps_sliced_to_their_range():
+    """An entry spanning a sub-interval only contributes deps inside it."""
+    wide = deps_of((5, [tid(1)]), (80, [tid(2)]))
+    a = LatestDeps.create(rngs(0, 100), KnownDeps.KNOWN, ballot(1), wide, None)
+    # a competing higher-phase claim on [50, 100) hides the [50,100) slice of a
+    b = LatestDeps.create(rngs(50, 100), KnownDeps.KNOWN, ballot(9),
+                          deps_of((80, [tid(3)])), None)
+    merged = LatestDeps.merge_all([a, b])
+    deps, _ = merged.merge_commit(tid(20), Timestamp(1, 30, 2))
+    got = set(deps.txn_ids())
+    assert tid(1) in got
+    # [80] comes from whichever entry won [50,100); both are KNOWN so the
+    # winner is deterministic by reduce order — what matters is no union
+    assert not (tid(2) in got and tid(3) in got)
+
+
+def test_get_deps_round_end_to_end():
+    """CollectDeps: a GetDeps quorum returns the conflicting txns for a
+    footprint at a bound."""
+    from cassandra_accord_tpu.coordinate.collect_deps import collect_deps
+    from cassandra_accord_tpu.primitives.keys import RoutingKeys
+    from cassandra_accord_tpu.primitives.route import Route
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    cluster = Cluster(Topology(1, shards), seed=11)
+    results = [cluster.nodes[1].coordinate(list_txn([k(5)], {k(5): f"v{i}"}))
+               for i in range(3)]
+    assert cluster.run_until(lambda: all(r.is_done() for r in results))
+    cluster.run_until_idle()
+    node = cluster.nodes[2]
+    probe = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+    route = Route.for_keys(rk(5), RoutingKeys.of([rk(5)]))
+    got = collect_deps(node, probe, route, [k(5)],
+                       node.unique_now())
+    assert cluster.run_until(lambda: got.is_done())
+    assert got.failure is None
+    assert len(got.value.txn_ids()) >= 1   # the committed writes conflict

@@ -316,6 +316,31 @@ def test_live_ops_not_replayed_on_recycled_slot():
     assert {t for _, t in got} == {w1, b}   # parity-asserted; b must survive
 
 
+def test_elision_bounds_deps_under_contention():
+    """Deep committed history on one key must NOT inflate deps answers: the
+    covering write stands in for everything it orders (elision), so the
+    answer stays O(uncommitted + 1) while the index holds hundreds."""
+    store, verify = make_pair()
+    for i in range(300):
+        t = tid(10 + 2 * i)
+        register_both(store, verify, t, InternalStatus.PREACCEPTED, None, [rk(0)])
+        register_both(store, verify, t, InternalStatus.COMMITTED,
+                      Timestamp(1, 11 + 2 * i, 0, 1), [rk(0)])
+    # a couple of in-flight (uncommitted) txns remain visible
+    u1, u2 = tid(1000, node=2), tid(1001, node=3)
+    register_both(store, verify, u1, InternalStatus.PREACCEPTED, None, [rk(0)])
+    register_both(store, verify, u2, InternalStatus.ACCEPTED,
+                  Timestamp(1, 1002, 0, 3), [rk(0)])
+    q = tid(2000)
+    got = verify.key_conflicts(q, [rk(0)], q.as_timestamp())
+    deps = {t for _, t in got}
+    assert u1 in deps and u2 in deps
+    assert tid(10 + 2 * 299) in deps          # the covering write itself
+    assert len(deps) == 3, f"elision failed to bound deps: {len(deps)}"
+    # and the timestamp proposal still sees the full history's max
+    assert verify.max_conflict_keys([rk(0)]) is not None
+
+
 def test_txnid_rebuild_keeps_kind():
     """TxnId flag-rebuild paths (merge_max, with_rejected) must preserve the
     kind cache."""
