@@ -73,6 +73,40 @@ def last_cluster():
     return ref() if ref is not None else None
 
 
+def verify_frontiers(cluster) -> int:
+    """Frontier parity (SURVEY §7 stage 8): the kernel-computed execution
+    frontier (kahn_frontier over the resolver's mirrored wait graph) must
+    equal the event-driven WaitingOn state on every store.  Valid at
+    quiescent points (between tasks, no deferred store executors).  Returns
+    stores checked."""
+    from ..impl.resolver import VerifyDepsResolver
+    from ..local.cfk import InternalStatus
+    from ..utils.invariants import check_state
+    stable_i = int(InternalStatus.STABLE)
+    checked = 0
+    for node in cluster.nodes.values():
+        for store in node.command_stores.all_stores():
+            r = store.resolver
+            if not isinstance(r, VerifyDepsResolver):
+                continue
+            tpu = r.tpu
+            dev = tpu.frontier_ready()
+            host = set()
+            for tid, cmd in store.commands.items():
+                m = tpu.txns.get(tid)
+                if m is None or m.status != stable_i \
+                        or cmd.save_status.is_truncated:
+                    continue
+                if cmd.waiting_on is not None and not cmd.waiting_on.is_waiting():
+                    host.add(tid)
+            check_state(dev == host,
+                        "frontier parity violation on node %s store %s: "
+                        "device-only=%s host-only=%s", node.id, store.id,
+                        sorted(dev - host), sorted(host - dev))
+            checked += 1
+    return checked
+
+
 def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              link_config: Optional[LinkConfig] = None,
              nodes: Optional[int] = None, rf: Optional[int] = None,
@@ -154,6 +188,11 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 global_cycle_time_s=global_cycle)
             sched.start()
             durability_scheduling.append(sched)
+    frontier_task = None
+    if resolver == "verify" and not chaos and not delayed_stores:
+        # continuous frontier parity at (deterministic) quiescent task points
+        frontier_task = cluster.scheduler.recurring(
+            0.7, lambda: verify_frontiers(cluster))
     verifier = StrictSerializabilityVerifier()
     result = BurnResult(seed)
     zipf = rng.next_boolean()
@@ -288,6 +327,9 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         if hasattr(cluster.link, "heal"):
             cluster.link.heal()
         cluster.run_until_idle(max_tasks=max_tasks)
+        if frontier_task is not None:
+            frontier_task.cancel()
+            verify_frontiers(cluster)   # final quiescent frontier parity
         result.ops_submitted = state["submitted"]
         result.sim_micros = cluster.now_micros
         result.stats = dict(cluster.stats)

@@ -87,6 +87,18 @@ class DepsResolver:
     def end_batch(self) -> None:
         """The delivery window ended: drop any prefetched answers."""
 
+    # -- execution-frontier plane (Commands WaitingOn mirror) -----------------
+    def register_waiting(self, waiter: TxnId, deps) -> None:
+        """The execute-phase wait graph: ``waiter`` blocks on ``deps``
+        (Commands.initialiseWaitingOn, Commands.java:688).  Device resolvers
+        mirror the edges so the execution frontier can be computed as one
+        kernel pass (ops.deps_kernels.kahn_frontier); host resolvers rely on
+        the event-driven WaitingOn and ignore this."""
+
+    def remove_waiting(self, waiter: TxnId, dep: TxnId) -> None:
+        """An edge drained (dep applied/invalidated/truncated or provably
+        ordered after the waiter — Commands.java:704-775)."""
+
     def register(self, txn_id: TxnId, status: "InternalStatus",
                  execute_at: Optional[Timestamp],
                  keys: Tuple[RoutingKey, ...]) -> None:
@@ -191,6 +203,12 @@ class VerifyDepsResolver(DepsResolver):
 
     def end_batch(self) -> None:
         self.tpu.end_batch()
+
+    def register_waiting(self, waiter, deps) -> None:
+        self.tpu.register_waiting(waiter, deps)
+
+    def remove_waiting(self, waiter, dep) -> None:
+        self.tpu.remove_waiting(waiter, dep)
 
     def register(self, txn_id, status, execute_at, keys) -> None:
         self.cpu.register(txn_id, status, execute_at, keys)

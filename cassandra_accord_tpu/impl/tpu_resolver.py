@@ -205,6 +205,9 @@ class TpuDepsResolver(DepsResolver):
         self.walk_consults = 0
         self.host_consults = 0
         self.device_consults = 0
+        # execute-phase wait-graph mirror (Commands WaitingOn edges), the input
+        # to the kernel-computed execution frontier
+        self.edges: Dict[TxnId, Set[TxnId]] = {}
         # prefetched-answer cache for the current delivery window (None = no
         # window active): sig -> answer, plus keys dirtied/hardened since
         self._cache: Optional[Dict[tuple, object]] = None
@@ -346,6 +349,7 @@ class TpuDepsResolver(DepsResolver):
                 del self.txns[txn_id]
                 del self.txn_at[m.slot]
                 self._dirty_txns.discard(txn_id)
+                self.edges.pop(txn_id, None)
                 heapq.heappush(self.free_slots, m.slot)
         if cw_removed and key in self.key_slot:
             # the covering bound may have receded: un-cover survivors at or
@@ -502,6 +506,47 @@ class TpuDepsResolver(DepsResolver):
         else:
             self.prefetch_hits += 1
         return True, self._cache[sig], delta_ids
+
+    # -- execution-frontier plane ---------------------------------------------
+    def register_waiting(self, waiter: TxnId, deps) -> None:
+        self.edges[waiter] = set(deps)
+
+    def remove_waiting(self, waiter: TxnId, dep: TxnId) -> None:
+        s = self.edges.get(waiter)
+        if s is not None:
+            s.discard(dep)
+
+    def frontier_ready(self) -> Set[TxnId]:
+        """The execution frontier as ONE kernel pass
+        (ops.deps_kernels.kahn_frontier over the mirrored wait graph): every
+        indexed STABLE txn whose remaining wait edges all point at
+        done/evicted slots.  Edges to txns outside the index (range txns,
+        cross-epoch deps) conservatively block their waiter.  This is the
+        batch-executor view of the same frontier the event-driven WaitingOn
+        drains one notification at a time (Commands.java:617-775); the burn
+        harness asserts the two agree at quiescent points."""
+        import jax.numpy as jnp
+        from ..ops import deps_kernels as dk
+        self._flush()
+        t = self._t
+        adj = np.zeros((t, t), dtype=np.int8)
+        external = np.zeros((t,), dtype=np.bool_)
+        for waiter, deps in self.edges.items():
+            wm = self.txns.get(waiter)
+            if wm is None or not deps:
+                continue
+            for d in deps:
+                dm = self.txns.get(d)
+                if dm is None:
+                    external[wm.slot] = True
+                else:
+                    adj[wm.slot, dm.slot] = 1
+        h = self._h
+        ready = np.asarray(dk.kahn_frontier(
+            jnp.asarray(adj), jnp.asarray(h["status"]),
+            jnp.asarray(h["active"]))) & ~external
+        return {self.txn_at[int(s)] for s in np.nonzero(ready)[0]
+                if int(s) in self.txn_at}
 
     def _use_walk(self) -> bool:
         if self.tier == "auto":

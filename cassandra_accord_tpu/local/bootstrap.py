@@ -124,6 +124,7 @@ def _reevaluate_waiting(safe_store) -> None:
             parts = deps.participants(dep_id) if deps is not None else None
             if parts is not None and redundant.is_locally_redundant(dep_id, parts):
                 waiting.remove(dep_id, True)
+                store.resolver.remove_waiting(command.txn_id, dep_id)
                 dep = safe_store.get_if_exists(dep_id)
                 if dep is not None:
                     dep.listeners.discard(command.txn_id)

@@ -380,6 +380,8 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
             deferred |= _maybe_defer_execute_at_least(safe_store, command, dep,
                                                      notify=False)
     command.waiting_on = WaitingOn(waiting)
+    # mirror the wait edges into the resolver's execution-frontier plane
+    safe_store.store.resolver.register_waiting(command.txn_id, waiting)
     if deferred:
         safe_store.notify_listeners(command)
 
@@ -447,6 +449,7 @@ def update_dependency_and_maybe_execute(safe_store: SafeCommandStore, waiter: Co
     if not _still_blocks(safe_store, waiter, dep.txn_id, waiter.execute_at):
         applied = dep.save_status is SaveStatus.APPLIED or dep.save_status.is_truncated
         waiter.waiting_on.remove(dep.txn_id, applied)
+        safe_store.store.resolver.remove_waiting(waiter.txn_id, dep.txn_id)
         dep.listeners.discard(waiter.txn_id)
         maybe_execute(safe_store, waiter, always_notify_listeners=False)
 

@@ -102,6 +102,15 @@ class CheckStatusOk(Reply):
         local = safe_store.current_ranges()
         if not len(local):
             return False
+        # an ABSENT command is ambiguous once the erase bound passed txnId:
+        # GC physically deletes shard-redundant APPLIED commands
+        # (command_store.run_gc), so a durably-applied txn would look exactly
+        # like a never-committed one here — no hint below that bound.  (A
+        # replica that erased it cannot claim the hint; one that still holds
+        # it reports the applied status, which suppresses the inference.)
+        bound = safe_store.redundant_before().min_shard_redundant_before(local)
+        if bound is not None and txn_id < bound:
+            return False
         from ..local.status import Durability as D
         return safe_store.durable_before().min_durability(
             txn_id, local) >= D.MAJORITY

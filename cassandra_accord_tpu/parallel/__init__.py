@@ -2,7 +2,9 @@
 from .mesh import (
     SHARD, make_mesh, state_specs, batch_specs, shard_state,
     build_sharded_step, build_sharded_closure,
+    build_sharded_store_consult, build_sharded_frontier,
 )
 
 __all__ = ["SHARD", "make_mesh", "state_specs", "batch_specs", "shard_state",
-           "build_sharded_step", "build_sharded_closure"]
+           "build_sharded_step", "build_sharded_closure",
+           "build_sharded_store_consult", "build_sharded_frontier"]

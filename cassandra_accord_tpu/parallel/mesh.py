@@ -163,6 +163,57 @@ def build_sharded_step(mesh: Mesh):
     return jax.jit(sharded)
 
 
+def build_sharded_store_consult(mesh: Mesh):
+    """The PROTOCOL data plane over the mesh: command-store parallelism.
+
+    Accord's native scaling axis is per-range command stores; on TPU each
+    device owns a store's conflict index and answers its consults locally
+    (impl/tpu_resolver device tier == ops.deps_kernels.consult), while the
+    coordinator-side timestamp proposal takes the lexicographic max of the
+    per-store max-conflicts ACROSS stores — an all_gather + lane-lex reduce
+    riding ICI (the on-device analog of SafeCommandStore.max_conflict merged
+    over CommandStores.map_reduce).
+
+    Inputs are store-stacked: index arrays [S, T, K]/[S, T, 5]/[S, T] and
+    query batches [S, B, K]/[S, B, 5]/[S, B], sharded over the store axis.
+    Returns (deps [S, B, T] sharded, global_max [B, 5] replicated)."""
+
+    def local(live_inc, key_inc, ts, txn_id, kind, status, active,
+              q, before, qkind):
+        deps, max_lanes = jax.vmap(dk.consult)(
+            live_inc, key_inc, ts, txn_id, kind, status, active,
+            q, before, qkind)                                   # [1, B, T/5]
+        gathered = jax.lax.all_gather(max_lanes[0], SHARD)       # [n, B, 5]
+        global_max = _lex_max_over_axis0(gathered)               # [B, 5]
+        return deps, global_max
+
+    spec3 = P(SHARD, None, None)
+    spec2 = P(SHARD, None)
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(spec3, spec3, spec3, spec3, spec2, spec2, spec2,
+                  spec3, spec3, spec2),
+        out_specs=(spec3, P()),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
+def build_sharded_frontier(mesh: Mesh):
+    """Per-store execution frontier over the mesh: each device runs
+    kahn_frontier on its own store's wait graph (no collective — stores'
+    frontiers are independent; cross-store ordering flows through deps)."""
+
+    def local(adj, status, active):
+        return jax.vmap(dk.kahn_frontier)(adj, status, active)
+
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(SHARD, None, None), P(SHARD, None), P(SHARD, None)),
+        out_specs=P(SHARD, None),
+        check_vma=False)
+    return jax.jit(sharded)
+
+
 def build_sharded_closure(mesh: Mesh):
     """Row-parallel transitive closure over the mesh: log2(T) rounds of
     (all_gather rows) then local [T/n, T] @ [T, T] matmul."""

@@ -75,8 +75,6 @@ class LatestDeps:
     def __init__(self, imap: Optional[ReducingIntervalMap] = None):
         self.map = imap if imap is not None else ReducingIntervalMap()
 
-    NONE: "LatestDeps"
-
     @staticmethod
     def create(ranges: Ranges, known: KnownDeps, ballot: Ballot,
                coordinated: Optional[Deps], local: Optional[Deps]) -> "LatestDeps":
@@ -86,9 +84,6 @@ class LatestDeps:
                             (local,) if local is not None else ())
         pairs = [(r.start, r.end) for r in ranges]
         return LatestDeps(ReducingIntervalMap.of_ranges(pairs, entry))
-
-    def is_empty(self) -> bool:
-        return all(v is None for v in self.map.values)
 
     def merge(self, other: "LatestDeps") -> "LatestDeps":
         return LatestDeps(self.map.merge(other.map, LatestEntry.reduce))
@@ -164,6 +159,3 @@ class LatestDeps:
             lambda v, lo, hi, _a: parts.append(f"[{lo},{hi})={v.known.name}")
             if v is not None else None, None)
         return f"LatestDeps({', '.join(parts)})"
-
-
-LatestDeps.NONE = LatestDeps()

@@ -63,3 +63,64 @@ def test_sharded_closure_matches():
     got = np.asarray(closure(jnp.asarray(adj)))
     want = np.asarray(ops.transitive_closure(jnp.asarray(adj)))
     assert (got == want).all()
+
+
+def test_sharded_store_consult_matches_single_device():
+    """The PROTOCOL plane over the mesh: per-store consults sharded one store
+    per device + cross-store timestamp-proposal reduce must equal running the
+    same consults store-by-store on one device."""
+    from cassandra_accord_tpu.ops import deps_kernels as dk
+    S, Ts, Ks, Bq = 8, 16, 8, 4
+    rng = np.random.default_rng(17)
+    key_inc = (rng.random((S, Ts, Ks)) < 0.3).astype(np.int8)
+    ts = np.zeros((S, Ts, 5), dtype=np.int32)
+    ts[..., 0] = 1
+    ts[..., 2] = rng.integers(1, 1000, (S, Ts))
+    ts[..., 4] = rng.integers(1, 8, (S, Ts))
+    txn_id = ts.copy()
+    kind = rng.integers(0, 2, (S, Ts)).astype(np.int8)
+    status = rng.integers(1, 6, (S, Ts)).astype(np.int8)
+    active = np.ones((S, Ts), dtype=bool)
+    q = (rng.random((S, Bq, Ks)) < 0.3).astype(np.int8)
+    before = np.zeros((S, Bq, 5), dtype=np.int32)
+    before[..., 0] = 1
+    before[..., 2] = 2000
+    qkind = rng.integers(0, 2, (S, Bq)).astype(np.int8)
+
+    mesh = parallel.make_mesh(8)
+    consult = parallel.build_sharded_store_consult(mesh)
+    deps_m, gmax = consult(*(jnp.asarray(x) for x in (
+        key_inc, key_inc, ts, txn_id, kind, status, active, q, before, qkind)))
+
+    # single-device reference: consult each store, lex-max across stores
+    singles = [dk.consult(*(jnp.asarray(x[s]) for x in (
+        key_inc, key_inc, ts, txn_id, kind, status, active, q, before, qkind)))
+        for s in range(S)]
+    for s in range(S):
+        assert (np.asarray(deps_m[s]) == np.asarray(singles[s][0])).all(), s
+    stack = np.stack([np.asarray(m) for _, m in singles])   # [S, B, 5]
+    want = np.zeros((Bq, 5), dtype=np.int64)
+    tie = np.ones((S, Bq), dtype=bool)
+    for lane in range(5):
+        v = np.where(tie, stack[..., lane], -1)
+        best = v.max(axis=0)
+        tie = tie & (stack[..., lane] == best[None, :])
+        want[:, lane] = np.maximum(best, 0)
+    assert (np.asarray(gmax) == want).all()
+
+
+def test_sharded_frontier_matches():
+    from cassandra_accord_tpu.ops import deps_kernels as dk
+    S, Ts = 8, 16
+    rng = np.random.default_rng(23)
+    adj = (rng.random((S, Ts, Ts)) < 0.15).astype(np.int8)
+    status = rng.integers(1, 7, (S, Ts)).astype(np.int8)
+    active = rng.random((S, Ts)) < 0.9
+    mesh = parallel.make_mesh(8)
+    frontier = parallel.build_sharded_frontier(mesh)
+    got = np.asarray(frontier(jnp.asarray(adj), jnp.asarray(status),
+                              jnp.asarray(active)))
+    for s in range(S):
+        want = np.asarray(dk.kahn_frontier(
+            jnp.asarray(adj[s]), jnp.asarray(status[s]), jnp.asarray(active[s])))
+        assert (got[s] == want).all(), s

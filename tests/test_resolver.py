@@ -341,6 +341,39 @@ def test_elision_bounds_deps_under_contention():
     assert verify.max_conflict_keys([rk(0)]) is not None
 
 
+def test_frontier_ready_kernel():
+    """The kernel-computed execution frontier (kahn_frontier over the wait
+    mirror): STABLE txns become ready exactly when their edges drain or point
+    at applied slots; external (unindexed) deps block conservatively."""
+    store, verify = make_pair()
+    tpu = verify.tpu
+    a, b, c = tid(10), tid(20), tid(30)
+    ext = tid(99, node=7)                       # never indexed here
+    for t, ks in ((a, [rk(0)]), (b, [rk(0)]), (c, [rk(10)])):
+        register_both(store, verify, t, InternalStatus.PREACCEPTED, None, ks)
+        register_both(store, verify, t, InternalStatus.STABLE,
+                      Timestamp(1, t.hlc + 1, 0, 1), ks)
+    tpu.register_waiting(a, set())
+    tpu.register_waiting(b, {a})
+    tpu.register_waiting(c, {ext})
+    assert tpu.frontier_ready() == {a}          # b blocked by a, c by external
+    register_both(store, verify, a, InternalStatus.APPLIED, None, [rk(0)])
+    tpu.remove_waiting(b, a)
+    assert tpu.frontier_ready() == {b}          # a no longer STABLE; c external
+    tpu.remove_waiting(c, ext)
+    assert tpu.frontier_ready() == {b, c}
+
+
+def test_burn_frontier_parity_runs():
+    """The verify-resolver burn continuously asserts kernel-frontier ==
+    event-driven WaitingOn; make sure the check actually covers stores."""
+    from cassandra_accord_tpu.harness.burn import run_burn, verify_frontiers, last_cluster
+    result = run_burn(seed=987, ops=60, concurrency=8, resolver="verify")
+    assert result.ops_ok == 60
+    cluster = last_cluster()
+    assert cluster is not None and verify_frontiers(cluster) > 0
+
+
 def test_txnid_rebuild_keeps_kind():
     """TxnId flag-rebuild paths (merge_max, with_rejected) must preserve the
     kind cache."""
