@@ -68,10 +68,15 @@ def bench_protocol(resolver: str, batch_window_us: int, ops: int = PROTO_OPS,
 
 
 def _make_index(rng, t, k, hot=8, keys_per_txn=2):
-    """A contended in-flight index: 50% of txns on the hot key set."""
+    """A contended in-flight index: 50% of txns on the hot key set (wide
+    range-join shapes — keys_per_txn > hot — draw uniformly instead)."""
     key_inc = np.zeros((t, k), dtype=np.int8)
     hot_mask = rng.random(t) < 0.5
+    wide = keys_per_txn > hot
     for i in range(t):
+        if wide:
+            key_inc[i, rng.choice(k, keys_per_txn, replace=False)] = 1
+            continue
         pool = hot if hot_mask[i] else k - hot
         off = 0 if hot_mask[i] else hot
         key_inc[i, off + rng.choice(pool, keys_per_txn, replace=False)] = 1
@@ -88,7 +93,11 @@ def _make_index(rng, t, k, hot=8, keys_per_txn=2):
 def _make_queries(rng, b, k, t, hot=8, keys_per_txn=2):
     q = np.zeros((b, k), dtype=np.int8)
     hot_mask = rng.random(b) < 0.5
+    wide = keys_per_txn > hot
     for i in range(b):
+        if wide:
+            q[i, rng.choice(k, keys_per_txn, replace=False)] = 1
+            continue
         pool = hot if hot_mask[i] else k - hot
         off = 0 if hot_mask[i] else hot
         q[i, off + rng.choice(pool, keys_per_txn, replace=False)] = 1
@@ -130,26 +139,28 @@ def host_python_scalar(key_inc, txn_id, active, q, before, sample=32):
     return done / (time.perf_counter() - t0)
 
 
-def bench_kernel(t, k=512, b=256, iters=20):
+def bench_kernel(t, k=512, b=256, iters=20, keys_per_txn=2, packed=False):
     import jax
     import jax.numpy as jnp
     from cassandra_accord_tpu.ops import deps_kernels as dk
     rng = np.random.default_rng(42)
-    key_inc, lanes, kind, status, active = _make_index(rng, t, k)
-    q, before, qkind = _make_queries(rng, b, k, t)
+    key_inc, lanes, kind, status, active = _make_index(rng, t, k,
+                                                       keys_per_txn=keys_per_txn)
+    q, before, qkind = _make_queries(rng, b, k, t, keys_per_txn=keys_per_txn)
     index_dev = [jnp.asarray(x) for x in
                  (key_inc, key_inc, lanes, lanes, kind, status, active)]
     # DISTINCT query batch per iteration: identical repeated computations can
     # be served from caches (driver/tunnel level) and would overstate rates
     batches = []
     for _ in range(iters):
-        qi, bi, ki = _make_queries(rng, b, k, t)
+        qi, bi, ki = _make_queries(rng, b, k, t, keys_per_txn=keys_per_txn)
         batches.append((jnp.asarray(qi), jnp.asarray(bi), jnp.asarray(ki)))
+    kernel = dk.consult_packed if packed else dk.consult
     # warmup/compile
-    jax.block_until_ready(dk.consult(*index_dev, jnp.asarray(q),
-                                     jnp.asarray(before), jnp.asarray(qkind)))
+    jax.block_until_ready(kernel(*index_dev, jnp.asarray(q),
+                                 jnp.asarray(before), jnp.asarray(qkind)))
     t0 = time.perf_counter()
-    outs = [dk.consult(*index_dev, *bt) for bt in batches]
+    outs = [kernel(*index_dev, *bt) for bt in batches]
     jax.block_until_ready(outs)
     dev_qps = iters * b / (time.perf_counter() - t0)
     # numpy-vectorized host baseline: the resolver's own host tier
@@ -161,12 +172,48 @@ def bench_kernel(t, k=512, b=256, iters=20):
     py_qps = host_python_scalar(key_inc, lanes, active, q, before)
     matmul_flops = 2.0 * b * k * t
     tflops = dev_qps / b * matmul_flops / 1e12
-    return {"T": t, "K": k, "B": b,
+    return {"T": t, "K": k, "B": b, "keys_per_txn": keys_per_txn,
+            "packed_result": packed,
+            "index_bytes_int8": 2 * t * k,
             "device_queries_per_sec": round(dev_qps, 1),
             "host_numpy_queries_per_sec": round(np_qps, 1),
             "host_python_scalar_queries_per_sec": round(py_qps, 1),
             "device_vs_host_numpy": round(dev_qps / np_qps, 2),
             "device_join_tflops": round(tflops, 4)}
+
+
+def bench_graph(t=8192, iters=3):
+    """BASELINE config-5 shape: cycle-heavy adversarial dependency graph —
+    transitive closure, SCC condensation (cycle handling), and the Kahn
+    frontier, all as matmul kernels.  Dense [T, T] int8 adjacency: the stated
+    memory budget is T^2 bytes (64 MB at 8k; dense caps ~64k on one chip —
+    beyond that the index shards over the mesh, parallel/mesh.py)."""
+    import jax
+    import jax.numpy as jnp
+    from cassandra_accord_tpu.ops import deps_kernels as dk
+    rng = np.random.default_rng(9)
+    adj = (rng.random((t, t)) < (8.0 / t)).astype(np.int8)   # ~8 deps/txn
+    np.fill_diagonal(adj, 0)
+    status = np.full((t,), 4, dtype=np.int8)                 # STABLE
+    active = np.ones((t,), dtype=bool)
+    a = jnp.asarray(adj)
+    s, act = jnp.asarray(status), jnp.asarray(active)
+    out = {"T": t, "adjacency_bytes": t * t,
+           "deps_per_txn": float(adj.sum() / t)}
+    closure_flops = 2.0 * t * t * t * max(1, int(t - 1).bit_length())
+    for name, fn, args, flops in (
+            ("closure", dk.transitive_closure, (a,), closure_flops),
+            ("scc_condense", dk.scc_condense, (a, act), closure_flops),
+            ("kahn_frontier", dk.kahn_frontier, (a, s, act), 2.0 * t * t)):
+        jax.block_until_ready(fn(*args))                     # compile
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            r = fn(*args)
+        jax.block_until_ready(r)
+        dt = (time.perf_counter() - t0) / iters
+        out[name] = {"seconds": round(dt, 4),
+                     "tflops": round(flops / dt / 1e12, 2)}
+    return out
 
 
 def main():
@@ -176,7 +223,14 @@ def main():
     cpu_cps, cpu_res = bench_protocol("cpu", batch_window_us=0)
     assert tpu_res.ops_ok == cpu_res.ops_ok, "workload mismatch"
     tel = {k: v for k, v in tpu_res.stats.items() if k.startswith("resolver_")}
-    kernels = [bench_kernel(4096), bench_kernel(65536)]
+    kernels = [
+        bench_kernel(4096),
+        bench_kernel(65536),
+        bench_kernel(65536, packed=True),                     # 8x less transfer
+        # BASELINE config 4: multi-key range txns, 1k keys/txn wide join
+        bench_kernel(65536, k=2048, b=64, keys_per_txn=1024, packed=True),
+    ]
+    graph = bench_graph()                                     # BASELINE config 5
     print(json.dumps({
         "metric": "protocol_commits_per_sec_tpu_dataplane",
         "value": round(tpu_cps, 1),
@@ -198,6 +252,7 @@ def main():
                          "tpu_batch_window_us": 3000},
             "tpu_resolver_telemetry": tel,
             "kernel_scaling": kernels,
+            "graph_kernels": graph,
         },
     }))
 

@@ -201,6 +201,11 @@ class TpuDepsResolver(DepsResolver):
         # oracle itself) beats the vectorized tiers' fixed overhead — the
         # third rung of the cost ladder: walk / host-vector / MXU
         self._walk_max = int(os.environ.get("ACCORD_TPU_WALK_MAX", "384"))
+        # above this capacity the persistent f32 host-tier mirrors (2 × K×T×4
+        # bytes) are not worth their memory — the canonical index stays int8
+        # (2 × T×K bytes) and the host tier casts per call (rare: the cost
+        # model prefers the device tier at that scale anyway)
+        self._f32_max = int(os.environ.get("ACCORD_TPU_F32_MAX", "16384"))
         self._walk: Optional[DepsResolver] = None
         self.walk_consults = 0
         self.host_consults = 0
@@ -528,25 +533,65 @@ class TpuDepsResolver(DepsResolver):
         import jax.numpy as jnp
         from ..ops import deps_kernels as dk
         self._flush()
-        t = self._t
-        adj = np.zeros((t, t), dtype=np.int8)
-        external = np.zeros((t,), dtype=np.bool_)
+        h = self._h
+        stable_i = 4   # ops.graph_state.STABLE == cfk.InternalStatus.STABLE
+        # COMPACT the wait graph: the dense kernel runs over only the slots
+        # that participate in edges (waiters + their indexed deps) — dense
+        # [T, T] would be quadratic in index capacity for a graph that is
+        # sparse by construction (elision bounds deps to concurrency)
+        involved: List[int] = []
+        pos: Dict[int, int] = {}
+
+        def slot_of(tid: TxnId) -> Optional[int]:
+            m = self.txns.get(tid)
+            return None if m is None else m.slot
+
+        edge_pairs: List[Tuple[int, int]] = []
+        external_waiters: Set[int] = set()
         for waiter, deps in self.edges.items():
-            wm = self.txns.get(waiter)
-            if wm is None or not deps:
+            ws = slot_of(waiter)
+            if ws is None or not deps:
                 continue
             for d in deps:
-                dm = self.txns.get(d)
-                if dm is None:
-                    external[wm.slot] = True
+                ds = slot_of(d)
+                if ds is None:
+                    external_waiters.add(ws)
                 else:
-                    adj[wm.slot, dm.slot] = 1
-        h = self._h
-        ready = np.asarray(dk.kahn_frontier(
-            jnp.asarray(adj), jnp.asarray(h["status"]),
-            jnp.asarray(h["active"]))) & ~external
-        return {self.txn_at[int(s)] for s in np.nonzero(ready)[0]
-                if int(s) in self.txn_at}
+                    edge_pairs.append((ws, ds))
+        for a, b in edge_pairs:
+            for s in (a, b):
+                if s not in pos:
+                    pos[s] = len(involved)
+                    involved.append(s)
+        for s in external_waiters:
+            if s not in pos:
+                pos[s] = len(involved)
+                involved.append(s)
+        ready_ids: Set[TxnId] = set()
+        # stable slots with no wait edges at all are ready outright
+        waiting_slots = {a for a, _ in edge_pairs} | external_waiters
+        for s in np.nonzero(h["active"] & (h["status"] == stable_i))[0]:
+            s = int(s)
+            if s not in waiting_slots and s in self.txn_at:
+                ready_ids.add(self.txn_at[s])
+        if involved:
+            n = len(involved)
+            n_pad = 1 << max(3, (n - 1).bit_length())   # pow2 jit buckets
+            adj = np.zeros((n_pad, n_pad), dtype=np.int8)
+            for a, b in edge_pairs:
+                adj[pos[a], pos[b]] = 1
+            idx = np.asarray(involved)
+            status = np.zeros((n_pad,), dtype=h["status"].dtype)
+            active = np.zeros((n_pad,), dtype=np.bool_)   # pad rows inactive
+            status[:n] = h["status"][idx]
+            active[:n] = h["active"][idx]
+            ready = np.asarray(dk.kahn_frontier(
+                jnp.asarray(adj), jnp.asarray(status), jnp.asarray(active)))
+            for i in np.nonzero(ready)[0]:
+                s = involved[int(i)]
+                if s not in external_waiters and s in self.txn_at:
+                    ready_ids.add(self.txn_at[s])
+        return ready_ids
 
     def _use_walk(self) -> bool:
         if self.tier == "auto":
@@ -666,6 +711,12 @@ class TpuDepsResolver(DepsResolver):
         consult bit-for-bit."""
         self.host_consults += 1
         h = self._h
+        if "key_inc_f32" not in h:
+            # above the f32-mirror bound: cast per call (the cost model rarely
+            # routes here at that scale — device tier amortizes far better)
+            h = dict(h)
+            h["key_inc_f32"] = h["key_inc"].T.astype(np.float32)
+            h["live_f32"] = h["live_inc"].T.astype(np.float32)
         committed_i, invalidated_i = _status_codes()
         deps = None
         if want_deps:
@@ -710,10 +761,20 @@ class TpuDepsResolver(DepsResolver):
             kind = np.concatenate(
                 [kind, np.zeros((b_pad - b,), dtype=kind.dtype)])
         s = self._device
-        deps, max_lanes = jax.device_get(dk.consult(
-            s["live_inc"], s["key_inc"], s["ts"], s["txn_id"], s["kind"],
-            s["status"], s["active"], jnp.asarray(q), jnp.asarray(before),
-            jnp.asarray(kind)))
+        if self._t >= 32768:
+            # transfer-bound regime: bit-pack the deps mask on device (8×
+            # smaller result) and unpack host-side
+            packed, max_lanes = jax.device_get(dk.consult_packed(
+                s["live_inc"], s["key_inc"], s["ts"], s["txn_id"], s["kind"],
+                s["status"], s["active"], jnp.asarray(q), jnp.asarray(before),
+                jnp.asarray(kind)))
+            deps = np.unpackbits(packed, axis=1, bitorder="little") \
+                .astype(bool)[:, :self._t]
+        else:
+            deps, max_lanes = jax.device_get(dk.consult(
+                s["live_inc"], s["key_inc"], s["ts"], s["txn_id"], s["kind"],
+                s["status"], s["active"], jnp.asarray(q), jnp.asarray(before),
+                jnp.asarray(kind)))
         return deps[:b], max_lanes[:b]
 
     def _sync_device(self) -> None:
@@ -725,7 +786,7 @@ class TpuDepsResolver(DepsResolver):
         h = self._h
         self._device = {
             "key_inc": jnp.asarray(h["key_inc"]),
-            "live_inc": jnp.asarray((h["live_f32"].T > 0).astype(np.int8)),
+            "live_inc": jnp.asarray(h["live_inc"]),
             "ts": jnp.asarray(h["ts"]),
             "txn_id": jnp.asarray(h["txn_id"]),
             "kind": jnp.asarray(h["kind"]),
@@ -811,7 +872,7 @@ class TpuDepsResolver(DepsResolver):
         amortised)."""
         t, k = self._t, self._k
         key_inc = np.zeros((t, k), dtype=np.int8)
-        live_f32 = np.zeros((k, t), dtype=np.float32)
+        live_inc = np.zeros((t, k), dtype=np.int8)
         ts = np.zeros((t, TS_LANES), dtype=np.int32)
         txn_id = np.zeros((t, TS_LANES), dtype=np.int32)
         kind = np.zeros((t,), dtype=np.int8)
@@ -821,16 +882,21 @@ class TpuDepsResolver(DepsResolver):
             cols = [self.key_slot[rk] for rk in m.keys]
             key_inc[m.slot, cols] = 1
             live_cols = [self.key_slot[rk] for rk in m.keys - m.covered]
-            live_f32[live_cols, m.slot] = 1.0
+            live_inc[m.slot, live_cols] = 1
             ts[m.slot] = m.execute_at.pack_lanes()
             txn_id[m.slot] = tid.pack_lanes()
             kind[m.slot] = m.kind_code
             status[m.slot] = m.status
             active[m.slot] = True
-        self._h = {"key_inc": key_inc, "key_inc_f32": key_inc.T.astype(np.float32),
-                   "live_f32": live_f32,
+        self._h = {"key_inc": key_inc, "live_inc": live_inc,
                    "ts": ts, "txn_id": txn_id, "kind": kind, "status": status,
                    "active": active}
+        if t <= self._f32_max:
+            # persistent transposed f32 mirrors for the BLAS host tier; above
+            # the bound the host tier casts per call (memory budget: the
+            # canonical index stays 2 × T×K int8 bytes)
+            self._h["key_inc_f32"] = key_inc.T.astype(np.float32)
+            self._h["live_f32"] = live_inc.T.astype(np.float32)
         self._device_clean = False
         self._dirty_txns.clear()
         self._clear_bits.clear()
@@ -848,32 +914,40 @@ class TpuDepsResolver(DepsResolver):
                 or self._live_ops):
             return
         h = self._h
+        f32 = "key_inc_f32" in h
         # order matters: clears and deactivations target OLD occupants of a
         # slot; inserts (which may recycle that same slot) must land last
         for row, col in self._clear_bits:
             h["key_inc"][row, col] = 0
-            h["key_inc_f32"][col, row] = 0.0
-            h["live_f32"][col, row] = 0.0
+            h["live_inc"][row, col] = 0
+            if f32:
+                h["key_inc_f32"][col, row] = 0.0
+                h["live_f32"][col, row] = 0.0
         self._clear_bits.clear()
         if self._deactivate:
             d = np.asarray(self._deactivate, dtype=np.int32)
             h["active"][d] = False
             h["key_inc"][d] = 0
-            h["key_inc_f32"][:, d] = 0.0
-            h["live_f32"][:, d] = 0.0
+            h["live_inc"][d] = 0
+            if f32:
+                h["key_inc_f32"][:, d] = 0.0
+                h["live_f32"][:, d] = 0.0
             h["status"][d] = 0
             self._deactivate.clear()
         for tid in sorted(self._dirty_txns):    # deterministic flush order
             m = self.txns[tid]
             row = m.slot
             h["key_inc"][row] = 0
-            h["key_inc_f32"][:, row] = 0.0
-            h["live_f32"][:, row] = 0.0
+            h["live_inc"][row] = 0
             cols = [self.key_slot[rk] for rk in m.keys]
             h["key_inc"][row, cols] = 1
-            h["key_inc_f32"][cols, row] = 1.0
             live_cols = [self.key_slot[rk] for rk in m.keys - m.covered]
-            h["live_f32"][live_cols, row] = 1.0
+            h["live_inc"][row, live_cols] = 1
+            if f32:
+                h["key_inc_f32"][:, row] = 0.0
+                h["live_f32"][:, row] = 0.0
+                h["key_inc_f32"][cols, row] = 1.0
+                h["live_f32"][live_cols, row] = 1.0
             h["ts"][row] = m.execute_at.pack_lanes()
             h["txn_id"][row] = tid.pack_lanes()
             h["kind"][row] = m.kind_code
@@ -885,7 +959,9 @@ class TpuDepsResolver(DepsResolver):
         # IS the final state) is consistent; flips on un-dirty rows apply here
         for row, col, val in self._live_ops:
             if h["key_inc"][row, col]:      # incidence may have pruned since
-                h["live_f32"][col, row] = float(val)
+                h["live_inc"][row, col] = val
+                if f32:
+                    h["live_f32"][col, row] = float(val)
         self._live_ops.clear()
         self._device_clean = False
 

@@ -199,6 +199,28 @@ def consult(index_live_inc: jax.Array,  # [T, K] int8 — covered bits zeroed
     return deps, max_lanes
 
 
+@jax.jit
+def consult_packed(index_live_inc: jax.Array, index_key_inc: jax.Array,
+                   index_ts: jax.Array, index_txn_id: jax.Array,
+                   index_kind: jax.Array, index_status: jax.Array,
+                   index_active: jax.Array, batch_key_inc: jax.Array,
+                   batch_before: jax.Array, batch_kind: jax.Array,
+                   ) -> Tuple[jax.Array, jax.Array]:
+    """``consult`` with the deps mask BIT-PACKED on device ([B, T/8] uint8,
+    little-endian bit order, T a multiple of 8): at T = 64k the [B, T] bool
+    transfer dominates the launch round-trip (16 MB at B = 256); packing cuts
+    it 8× before it leaves HBM.  Hosts unpack with np.unpackbits."""
+    deps, max_lanes = consult(index_live_inc, index_key_inc, index_ts,
+                              index_txn_id, index_kind, index_status,
+                              index_active, batch_key_inc, batch_before,
+                              batch_kind)
+    b, t = deps.shape
+    bits = deps.reshape(b, t // 8, 8).astype(jnp.uint32)
+    weights = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint32)
+    packed = jnp.sum(bits * weights, axis=-1).astype(jnp.uint8)
+    return packed, max_lanes
+
+
 # ---------------------------------------------------------------------------
 # Transitive closure / elision
 # ---------------------------------------------------------------------------

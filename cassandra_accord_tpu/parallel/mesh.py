@@ -182,8 +182,11 @@ def build_sharded_store_consult(mesh: Mesh):
               q, before, qkind):
         deps, max_lanes = jax.vmap(dk.consult)(
             live_inc, key_inc, ts, txn_id, kind, status, active,
-            q, before, qkind)                                   # [1, B, T/5]
-        gathered = jax.lax.all_gather(max_lanes[0], SHARD)       # [n, B, 5]
+            q, before, qkind)                                   # [Sl, B, T/5]
+        # reduce the LOCAL store axis first (a device may own several stores
+        # when S > mesh size), then combine across devices
+        local_max = _lex_max_over_axis0(max_lanes)               # [B, 5]
+        gathered = jax.lax.all_gather(local_max, SHARD)          # [n, B, 5]
         global_max = _lex_max_over_axis0(gathered)               # [B, 5]
         return deps, global_max
 

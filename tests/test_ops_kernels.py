@@ -228,3 +228,31 @@ def test_graph_state_insert_evict(nprng):
     st = ops.evict_mask(st, keep)
     assert not bool(st.active[3])
     assert int(st.status[3]) == 0 and int(st.ts[3, 0]) == 0
+
+
+def test_consult_packed_matches_consult():
+    """Bit-packed consult output unpacks to exactly the boolean mask."""
+    import numpy as np
+    import jax.numpy as jnp
+    from cassandra_accord_tpu.ops import deps_kernels as dk
+    rng = np.random.default_rng(3)
+    t, k, b = 64, 16, 8
+    args = (
+        (rng.random((t, k)) < 0.3).astype(np.int8),
+        (rng.random((t, k)) < 0.4).astype(np.int8),
+        rng.integers(0, 100, (t, 5)).astype(np.int32),
+        rng.integers(0, 100, (t, 5)).astype(np.int32),
+        rng.integers(0, 2, t).astype(np.int8),
+        rng.integers(0, 7, t).astype(np.int8),
+        (rng.random(t) < 0.9),
+        (rng.random((b, k)) < 0.3).astype(np.int8),
+        np.full((b, 5), 50, dtype=np.int32),
+        rng.integers(0, 2, b).astype(np.int8),
+    )
+    jargs = tuple(jnp.asarray(a) for a in args)
+    deps, mx = dk.consult(*jargs)
+    packed, mx2 = dk.consult_packed(*jargs)
+    unpacked = np.unpackbits(np.asarray(packed), axis=1,
+                             bitorder="little").astype(bool)[:, :t]
+    assert (unpacked == np.asarray(deps)).all()
+    assert (np.asarray(mx) == np.asarray(mx2)).all()
