@@ -236,6 +236,12 @@ class TpuDepsResolver(DepsResolver):
             m = _TxnMirror(slot, int(txn_id.kind), status_i, ea, set())
             self.txns[txn_id] = m
             self.txn_at[slot] = txn_id
+        elif status_i == invalidated_i and committed_i <= m.status \
+                and m.status != invalidated_i:
+            # committed txns can never be invalidated (cfk.update's guard):
+            # ignore the registration ENTIRELY — adding its keys while
+            # refusing its status would split the cfk and resolver planes
+            return
         else:
             # monotonic status; executeAt moves on upgrade or while ACCEPTED,
             # and is FINAL from COMMITTED on (cfk.update's invariant)

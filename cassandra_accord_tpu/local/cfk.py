@@ -139,6 +139,12 @@ class CommandsForKey:
             return False
         if found:
             info = self.by_id[i]
+            if status is InternalStatus.INVALIDATED \
+                    and info.status in _DECIDED:
+                # a committed txn can never be invalidated: a late/erroneous
+                # invalidation message must not corrupt the index (the
+                # covering-write entries are final from COMMITTED on)
+                return True
             if status > info.status:
                 was = info.status
                 info.status = status

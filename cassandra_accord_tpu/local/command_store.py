@@ -242,6 +242,13 @@ class SafeCommandStore:
     # -- registration -------------------------------------------------------
     def register_witness(self, command: Command, status: InternalStatus) -> None:
         """Index a txn in the per-key / range structures for deps calculation."""
+        from .status import Status as _S
+        if status is InternalStatus.INVALIDATED \
+                and command.has_been(_S.PRE_COMMITTED):
+            # a committed txn can never be invalidated: a late/erroneous
+            # invalidation must not touch ANY index plane (cfk, resolver,
+            # range table) — one choke point keeps the planes in lockstep
+            return
         scope = command.route.participants() if command.route is not None else None
         if scope is None:
             return

@@ -1,0 +1,135 @@
+"""Property suite for CommandsForKey, modeled on the reference's
+CommandsForKeyTest (CommandsForKeyTest.java:1-1103): randomized lifecycle
+sequences checked against a NAIVE re-implementation of the query semantics —
+including transitive elision — plus prune-guard invariants.
+"""
+from cassandra_accord_tpu.local.cfk import CommandsForKey, InternalStatus
+from cassandra_accord_tpu.primitives.keys import IntKey
+from cassandra_accord_tpu.primitives.timestamp import (Domain, Timestamp,
+                                                       TxnId, TxnKind)
+from cassandra_accord_tpu.utils import property as prop
+from cassandra_accord_tpu.utils import accord_gens as gens
+
+_DECIDED = (InternalStatus.COMMITTED, InternalStatus.STABLE,
+            InternalStatus.APPLIED)
+
+# one lifecycle event: (hlc, node, kind, final_status, ea_delta)
+_EVENTS = prop.lists(
+    prop.tuples(prop.ints(0, 200), prop.ints(1, 4),
+                prop.pick([TxnKind.WRITE, TxnKind.WRITE, TxnKind.READ]),
+                prop.pick([InternalStatus.PREACCEPTED, InternalStatus.ACCEPTED,
+                           InternalStatus.COMMITTED, InternalStatus.STABLE,
+                           InternalStatus.APPLIED, InternalStatus.INVALIDATED]),
+                prop.ints(0, 30)),
+    max_size=24)
+
+
+def _play(events):
+    """Drive a cfk through the lifecycle events; return (cfk, model) where
+    model is [(txn_id, status, execute_at)] of indexed entries."""
+    cfk = CommandsForKey(IntKey(0).to_routing())
+    model = {}
+    for hlc, node, kind, status, ea_delta in events:
+        tid = TxnId(1, hlc, node, kind, Domain.KEY)
+        ea = Timestamp(1, hlc + ea_delta, node) if ea_delta else None
+        # walk the lattice up to `status` the way the protocol would
+        path = [s for s in (InternalStatus.PREACCEPTED, InternalStatus.ACCEPTED,
+                            InternalStatus.COMMITTED, InternalStatus.STABLE,
+                            InternalStatus.APPLIED)
+                if s <= status] if status is not InternalStatus.INVALIDATED \
+            else [InternalStatus.PREACCEPTED, InternalStatus.INVALIDATED]
+        for s in path:
+            got_ea = ea if s >= InternalStatus.ACCEPTED else None
+            if cfk.update(tid, s, got_ea):
+                info = cfk.get(tid)
+                model[tid] = (info.status, info.execute_at)
+    return cfk, model
+
+
+def _naive_active(model, before, by_kind):
+    """The reference mapReduceActive semantics recomputed from scratch:
+    witness filter, invalidated/TK skip, and transitive elision below the
+    max committed WRITE executing before the bound
+    (CommandsForKey.java:925-986)."""
+    maxcw = None
+    for tid, (status, ea) in model.items():
+        if status in _DECIDED and tid.is_write and ea < before:
+            if maxcw is None or ea > maxcw:
+                maxcw = ea
+    out = set()
+    for tid, (status, ea) in model.items():
+        if not tid < before:
+            continue
+        if status in (InternalStatus.INVALIDATED,
+                      InternalStatus.TRANSITIVELY_KNOWN):
+            continue
+        if not by_kind.witnesses(tid.kind):
+            continue
+        if maxcw is not None and status in _DECIDED and ea < maxcw \
+                and TxnKind.WRITE.witnesses(tid.kind):
+            continue
+        out.add(tid)
+    return out
+
+
+@prop.for_all(_EVENTS, prop.ints(0, 250),
+              prop.pick([TxnKind.WRITE, TxnKind.READ]), tries=3000)
+def test_map_reduce_active_matches_naive(events, before_hlc, by_kind):
+    cfk, model = _play(events)
+    before = Timestamp(1, before_hlc, 5)
+    by = TxnId(1, before_hlc, 5, by_kind, Domain.KEY)
+    got = set()
+    cfk.map_reduce_active(before, by.witnesses, got.add)
+    assert got == _naive_active(model, before, by_kind)
+
+
+@prop.for_all(_EVENTS, tries=3000)
+def test_max_timestamp_matches_naive(events):
+    cfk, model = _play(events)
+    expect = None
+    for tid, (_status, ea) in model.items():
+        c = ea if ea > tid else tid
+        if expect is None or c > expect:
+            expect = c
+    assert cfk.max_timestamp() == expect
+
+
+@prop.for_all(_EVENTS, prop.ints(0, 250), tries=3000)
+def test_prune_guard_and_requery(events, bound_hlc):
+    """After a bound prune: pruned ids refuse resurrection (update returns
+    False), survivors still answer queries per the naive semantics."""
+    cfk, model = _play(events)
+    bound = TxnId(1, bound_hlc, 9)
+    pruned = set(cfk.prune_applied_before(bound))
+    for tid in pruned:
+        assert model[tid][0] in (InternalStatus.APPLIED,
+                                 InternalStatus.INVALIDATED)
+        assert tid < bound
+        assert not cfk.update(tid, InternalStatus.PREACCEPTED, None), \
+            "pruned entry must not resurrect"
+        del model[tid]
+    before = Timestamp(1, 300, 9)
+    by = TxnId(1, 300, 9, TxnKind.WRITE, Domain.KEY)
+    got = set()
+    cfk.map_reduce_active(before, by.witnesses, got.add)
+    assert got == _naive_active(model, before, by.kind)
+
+
+@prop.for_all(_EVENTS, tries=2000)
+def test_status_monotone_and_execute_at_final(events):
+    """Status never regresses; executeAt is immutable from COMMITTED on."""
+    cfk = CommandsForKey(IntKey(0).to_routing())
+    seen = {}
+    for hlc, node, kind, status, ea_delta in events:
+        tid = TxnId(1, hlc, node, kind, Domain.KEY)
+        ea = Timestamp(1, hlc + ea_delta, node) if ea_delta else None
+        cfk.update(tid, status, ea)
+        info = cfk.get(tid)
+        if info is None:
+            continue
+        prev = seen.get(tid)
+        if prev is not None:
+            assert info.status >= prev[0], "status regressed"
+            if prev[0] >= InternalStatus.COMMITTED:
+                assert info.execute_at == prev[1], "executeAt moved post-commit"
+        seen[tid] = (info.status, info.execute_at)
