@@ -242,9 +242,10 @@ class SafeCommandStore:
     # -- registration -------------------------------------------------------
     def register_witness(self, command: Command, status: InternalStatus) -> None:
         """Index a txn in the per-key / range structures for deps calculation."""
-        from .status import Status as _S
+        from .status import Status as _S, SaveStatus as _SS
         if status is InternalStatus.INVALIDATED \
-                and command.has_been(_S.PRE_COMMITTED):
+                and command.has_been(_S.PRE_COMMITTED) \
+                and command.save_status is not _SS.INVALIDATED:
             # a committed txn can never be invalidated: a late/erroneous
             # invalidation must not touch ANY index plane (cfk, resolver,
             # range table) — one choke point keeps the planes in lockstep
