@@ -84,6 +84,10 @@ def _find_local_witness(node: "Node", seekables: Seekables, min_epoch: int):
         ranges = store.current_ranges()
         if not ranges.contains_all(unseekables):
             continue
+        # the covering txn we're looking for is applied — exactly the class
+        # the cache-miss plane evicts; fault the cold set in for the scan
+        for cold_id in list(store.cold):
+            store.lookup(cold_id)
         best: TxnId = None
         for txn_id, command in store.commands.items():
             if command.save_status.ordinal < SaveStatus.APPLIED.ordinal \

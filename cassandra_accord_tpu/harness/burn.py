@@ -124,6 +124,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              progress_poll_s: float = 0.5,
              durability: bool = False,
              batch_window_us: int = 0,
+             cache_miss: bool = False,
              max_tasks: int = 20_000_000,
              tracer=None, on_submit=None) -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation.
@@ -188,6 +189,25 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 global_cycle_time_s=global_cycle)
             sched.start()
             durability_scheduling.append(sched)
+    cache_miss_task = None
+    if cache_miss:
+        # cache-miss injection (DelayedCommandStores.java:138-195 capability):
+        # keep evicting terminal commands so the protocol continuously runs
+        # against state that is NOT memory-resident and must fault back in
+        # from the journal (requires journal=True)
+        assert journal, "cache_miss injection requires the journal"
+        evict_rng = rng.fork()
+
+        def evict_some():
+            from ..local.command_store import SafeCommandStore
+            for node in cluster.nodes.values():
+                for cs in node.command_stores.all_stores():
+                    safe = SafeCommandStore(cs)
+                    for tid in list(cs.commands):
+                        if evict_rng.next_float() < 0.3:
+                            safe.evict(tid)
+        cache_miss_task = cluster.scheduler.recurring(0.4, evict_some)
+
     frontier_task = None
     if resolver == "verify" and not chaos and not delayed_stores:
         # continuous frontier parity at (deterministic) quiescent task points
@@ -327,12 +347,18 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         if hasattr(cluster.link, "heal"):
             cluster.link.heal()
         cluster.run_until_idle(max_tasks=max_tasks)
+        if cache_miss_task is not None:
+            cache_miss_task.cancel()
         if frontier_task is not None:
             frontier_task.cancel()
             verify_frontiers(cluster)   # final quiescent frontier parity
         result.ops_submitted = state["submitted"]
         result.sim_micros = cluster.now_micros
         result.stats = dict(cluster.stats)
+        if cache_miss:
+            result.stats["cache_miss_loads"] = sum(
+                cs.cache_miss_loads for node in cluster.nodes.values()
+                for cs in node.command_stores.all_stores())
         # data-plane telemetry (tpu/verify resolvers): batching + tier choices
         tel = {"prefetch_hits": 0, "prefetch_patched": 0, "prefetch_misses": 0,
                "walk_consults": 0, "host_consults": 0, "device_consults": 0}

@@ -72,6 +72,17 @@ class Journal:
             out[txn_id] = command
         return out
 
+    def reconstruct_one(self, store, txn_id: TxnId) -> Optional[Command]:
+        """Rebuild ONE command from its latest recorded state — the
+        cache-miss reload path (SafeCommandStore._fault_in)."""
+        full = self._last.get((store.node.id, store.id, txn_id))
+        if full is None:
+            return None
+        command = Command(txn_id)
+        for field, encoded in full.items():
+            setattr(command, field, codec.decode_value(encoded))
+        return command
+
     # -- verification ---------------------------------------------------------
     @staticmethod
     def _durable_status(status: SaveStatus) -> SaveStatus:
@@ -105,8 +116,9 @@ class Journal:
                     f"journal mismatch {txn_id}.{f}: live={va!r} rebuilt={vb!r}"
             assert (command.writes is None) == (copy.writes is None), \
                 f"journal writes mismatch for {txn_id}"
+        cold = getattr(store, "cold", set())
         for txn_id in rebuilt:
-            assert txn_id in live, \
+            assert txn_id in live or txn_id in cold, \
                 f"journal has {txn_id} the live store erased without journal.erase"
 
 

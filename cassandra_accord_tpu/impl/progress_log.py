@@ -123,7 +123,7 @@ class SimpleProgressLog(ProgressLog):
 
     def unwitnessed(self, txn_id, home_key, progress_shard) -> None:
         if progress_shard and txn_id not in self.coordinating:
-            cmd = self.store.commands.get(txn_id)
+            cmd = self.store.lookup(txn_id)
             if cmd is not None and cmd.route is not None:
                 self.coordinating[txn_id] = _CoordinateState(txn_id, cmd.route)
 
@@ -186,7 +186,7 @@ class SimpleProgressLog(ProgressLog):
             state = self.coordinating.get(txn_id)
             if state is None or state.progress is Progress.INVESTIGATING:
                 continue
-            command = self.store.commands.get(txn_id)
+            command = self.store.lookup(txn_id)
             if command is not None and (
                     command.save_status.ordinal >= SaveStatus.APPLIED.ordinal):
                 self._done(txn_id)
@@ -208,7 +208,7 @@ class SimpleProgressLog(ProgressLog):
             state = self.blocking.get(txn_id)
             if state is None or state.progress is Progress.INVESTIGATING:
                 continue
-            command = self.store.commands.get(txn_id)
+            command = self.store.lookup(txn_id)
             if command is not None and self._locally_resolved(command):
                 self.blocking.pop(txn_id, None)
                 continue
@@ -223,7 +223,7 @@ class SimpleProgressLog(ProgressLog):
 
         for txn_id in list(self.non_home.keys()):
             state = self.non_home.get(txn_id)
-            command = self.store.commands.get(txn_id)
+            command = self.store.lookup(txn_id)
             if command is None or command.has_been(Status.PRE_COMMITTED):
                 self.non_home.pop(txn_id, None)
                 continue
@@ -284,7 +284,7 @@ class SimpleProgressLog(ProgressLog):
             # fetch_data propagated any knowledge found; resolved iff the dep is
             # now APPLIED (or settled) *locally* — being merely (pre)committed
             # cluster-wide doesn't unblock local execution
-            command = self.store.commands.get(state.txn_id)
+            command = self.store.lookup(state.txn_id)
             if command is not None and self._locally_resolved(command):
                 self.blocking.pop(state.txn_id, None)
                 return

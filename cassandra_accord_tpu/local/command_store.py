@@ -76,6 +76,11 @@ class CommandStore:
         self.pending_bootstrap: Ranges = Ranges.EMPTY
         # optional persistence hook (harness Journal; simulated durability)
         self.journal = None
+        # cache-miss plane (PreLoadContext.java / DelayedCommandStores
+        # cache-miss injection): ids whose command state was EVICTED from
+        # memory and lives only in the journal; faulted back in on access
+        self.cold: set = set()
+        self.cache_miss_loads = 0
         # the conflict-index data plane (impl/resolver.py): answers the deps
         # and max-conflict queries; cpu = cfk walk, tpu = device GraphState
         from ..impl.resolver import make_resolver
@@ -97,6 +102,28 @@ class CommandStore:
         if not self.ranges_by_epoch:
             return Ranges.EMPTY
         return self.ranges_by_epoch[max(self.ranges_by_epoch)]
+
+    # -- cache-miss plane (PreLoadContext capability) ------------------------
+    def lookup(self, txn_id: TxnId) -> Optional[Command]:
+        """Fault-in-aware command read: EVERY reader (SafeCommandStore,
+        progress log, barrier scans) must see evicted state as if resident."""
+        cmd = self.commands.get(txn_id)
+        if cmd is None and txn_id in self.cold:
+            cmd = self._fault_in(txn_id)
+        return cmd
+
+    def _fault_in(self, txn_id: TxnId) -> Optional[Command]:
+        """Reload an evicted command from the journal (the store of record) —
+        the cache-miss path (PreLoadContext / AbstractSafeCommandStore async
+        loads; reloads here are synchronous, with the interleaving dimension
+        exercised by DelayedAgentExecutor's deferred store tasks)."""
+        self.cold.discard(txn_id)
+        cmd = self.journal.reconstruct_one(self, txn_id) \
+            if self.journal is not None else None
+        if cmd is not None:
+            self.commands[txn_id] = cmd
+            self.cache_miss_loads += 1
+        return cmd
 
     def all_ranges(self) -> Ranges:
         out = Ranges.EMPTY
@@ -145,13 +172,36 @@ class SafeCommandStore:
     # -- commands -----------------------------------------------------------
     def get_or_create(self, txn_id: TxnId) -> Command:
         cmd = self.store.commands.get(txn_id)
+        if cmd is None and txn_id in self.store.cold:
+            cmd = self._fault_in(txn_id)
         if cmd is None:
             cmd = Command(txn_id)
             self.store.commands[txn_id] = cmd
         return cmd
 
     def get_if_exists(self, txn_id: TxnId) -> Optional[Command]:
-        return self.store.commands.get(txn_id)
+        return self.store.lookup(txn_id)
+
+    def _fault_in(self, txn_id: TxnId) -> Optional["Command"]:
+        return self.store._fault_in(txn_id)
+
+    def evict(self, txn_id: TxnId) -> bool:
+        """Drop a TERMINAL command's in-memory state (journal keeps the
+        record).  Terminal = applied/invalidated/truncated: no further
+        transitions, so its listener registrations are historical and every
+        waiter was already notified at the transition."""
+        store = self.store
+        cmd = store.commands.get(txn_id)
+        if cmd is None or store.journal is None:
+            return False
+        from .status import SaveStatus as _SS, Status as _S
+        terminal = cmd.save_status in (_SS.APPLIED, _SS.INVALIDATED) \
+            or cmd.save_status.is_truncated
+        if not terminal:
+            return False
+        del store.commands[txn_id]
+        store.cold.add(txn_id)
+        return True
 
     # -- cfk ----------------------------------------------------------------
     def cfk(self, key: RoutingKey) -> CommandsForKey:
@@ -365,6 +415,17 @@ class SafeCommandStore:
         from .durability import Cleanup, should_cleanup
         from . import commands as C
         store = self.store
+        # evicted commands are still subject to GC — but only ids below the
+        # highest locally-redundant bound can possibly be cleanable
+        # (should_cleanup gates on is_locally_redundant), so only those fault
+        # in; the rest stay cold (faulting the whole set every round would
+        # defeat the eviction and re-heat the cache for nothing)
+        gc_bound = store.redundant_before.max_locally_redundant_over(
+            store.all_ranges())
+        if gc_bound is not None:
+            for cold_id in list(store.cold):
+                if cold_id < gc_bound:
+                    self.get_if_exists(cold_id)
         for txn_id, cmd in list(store.commands.items()):
             cleanup = should_cleanup(cmd, store.redundant_before, store.durable_before)
             if cleanup is Cleanup.NO:

@@ -120,6 +120,18 @@ class RedundantBefore:
                 return False
         return True
 
+    def max_locally_redundant_over(self, participants) -> Optional[TxnId]:
+        """The HIGHEST locally-redundant bound anywhere on ``participants`` —
+        a necessary condition filter: no txn at/above it can be cleanable
+        (is_locally_redundant requires being below the bound EVERYWHERE)."""
+        out: Optional[TxnId] = None
+        for e in _entries_over(self.map, participants):
+            if e is None:
+                continue
+            b = _max_ts(e.locally_applied_before, e.bootstrapped_at)
+            out = _max_ts(out, b)
+        return out
+
     def fence_before(self, key: RoutingKey) -> Optional[TxnId]:
         """The strongest fence txn covering ``key``: everything before it is
         implied-applied here (locally applied / bootstrap / shard-durable

@@ -183,6 +183,10 @@ def _scan_conflicting(safe_store: SafeCommandStore, txn_id: TxnId, keys):
     """Yield (command, footprint) for every other command conflicting with ``keys``
     whose kind would witness ours (the mapReduceFull scan; the reference indexes
     this via cfk, we scan the command map — recovery is rare)."""
+    # fault evicted commands back in: the evidence scan must see EVERY
+    # conflicting txn, memory-resident or not (cache-miss plane)
+    for cold_id in list(safe_store.store.cold):
+        safe_store.get_if_exists(cold_id)
     for other_id, command in safe_store.store.commands.items():
         if other_id == txn_id or not txn_id.witnessed_by(other_id.kind):
             continue

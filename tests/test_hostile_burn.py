@@ -62,3 +62,26 @@ def test_hostile_burn_verifies_resolver_parity(monkeypatch):
     result = run_burn(5, ops=40, concurrency=8, chaos=True, allow_failures=True,
                       durability=True, resolver="verify", max_tasks=3_000_000)
     assert result.resolved == 40
+
+
+def test_hostile_burn_with_cache_misses():
+    """Full fault matrix PLUS cache-miss injection: terminal commands keep
+    getting evicted, so recovery/evidence/GC paths run against state that
+    must fault back in from the journal (PreLoadContext capability)."""
+    result = run_burn(21, ops=60, concurrency=10, chaos=True,
+                      allow_failures=True, durability=True, journal=True,
+                      delayed_stores=True, cache_miss=True,
+                      max_tasks=3_000_000)
+    assert result.resolved == 60
+    assert result.stats.get("cache_miss_loads", 0) > 0, \
+        "eviction never forced a reload — the injection is not biting"
+
+
+def test_benign_burn_with_cache_misses_verify_resolver(monkeypatch):
+    """Cache misses under the parity-asserting resolver and journal verify:
+    reloads must leave every data plane consistent."""
+    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")
+    result = run_burn(22, ops=80, concurrency=8, journal=True,
+                      cache_miss=True, resolver="verify")
+    assert result.ops_ok == 80
+    assert result.stats.get("cache_miss_loads", 0) > 0
