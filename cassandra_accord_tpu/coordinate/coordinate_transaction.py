@@ -419,7 +419,7 @@ class _ExecuteTxn:
 
     def inform_durable(self) -> None:
         from ..local.status import Durability
-        from ..messages.status_messages import InformDurable
+        from ..messages.status_messages import InformDurable, InformHomeDurable
         for to in self.topologies.nodes():
             scope = TxnRequest.compute_scope(to, self.topologies, self.route)
             if scope is None:
@@ -427,6 +427,16 @@ class _ExecuteTxn:
             wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
             self.node.send(to, InformDurable(self.txn_id, scope, wait_for,
                                              self.execute_at, Durability.MAJORITY))
+        # the HOME shard owns global progress responsibility: tell it
+        # explicitly so its progress machinery stands down even where it
+        # holds no data for the txn (InformHomeDurable.java)
+        home_scope = self.route.home_key_only()
+        topology = self.node.topology.topology_for_epoch(self.txn_id.epoch)
+        shard = topology.for_key_required(self.route.home_key)
+        for to in shard.nodes:
+            self.node.send(to, InformHomeDurable(
+                self.txn_id, home_scope, self.txn_id.epoch,
+                self.execute_at, Durability.MAJORITY))
 
 
 # ---------------------------------------------------------------------------

@@ -74,7 +74,15 @@ def fetch_data(node: "Node", txn_id: TxnId, route: Route) -> au.AsyncResult:
         if merged is not None:
             target_route = merged.route if merged.route is not None else route
             merged.route = target_route
-            propagate_knowledge(node, txn_id, merged)
+            # apply as a first-class LOCAL request (serializable, typed,
+            # replayable — Propagate.java), delivered SYNCHRONOUSLY before
+            # the result settles: every fetch_data listener relies on the
+            # fetched knowledge being applied locally when it fires (a
+            # queued self-send would leave the progress log checking
+            # pre-propagation state and spuriously escalating to recovery)
+            from ..messages.base import LOCAL_NO_REPLY
+            from ..messages.status_messages import Propagate
+            node.receive(Propagate(txn_id, merged), node.id, LOCAL_NO_REPLY)
         result.set_success(merged)
 
     check_status_quorum(node, txn_id, route, include_info=True) \

@@ -290,7 +290,12 @@ class _Recover:
                 if this.done:
                     return
                 if isinstance(reply, InvalidateNack):
-                    if reply.committed:
+                    if reply.truncated:
+                        # settled below the durable fence: adopt and report
+                        adopt_erased(this.node, this.txn_id, this.route)
+                        this.fail(Truncated(this.txn_id,
+                                            "below the durable fence"))
+                    elif reply.committed:
                         # txn (pre)committed concurrently: restart recovery to
                         # pick up the commit evidence
                         this.retry()
@@ -353,6 +358,27 @@ class _Recover:
             if failure is None:
                 node.agent.metrics_events_listener().on_recover(txn_id, ballot)
         self.result.add_listener(notify)
+
+
+def adopt_erased(node: "Node", txn_id: TxnId, route: Route) -> None:
+    """A home-shard quorum member asserted ``txn_id`` sits below its durable
+    fence: the txn is settled (applied-then-erased, or can never commit).
+    Adopt the erased-tombstone state locally for any NOT-yet-decided copy so
+    waiters stop blocking on it (ErasedSafeCommand adoption; the truncate
+    notifies listeners).  Decided local copies are left alone — they resolve
+    through the normal apply path."""
+    from ..local import commands as C
+    from ..local.durability import Cleanup
+    from ..local.status import Status
+
+    def for_store(safe_store) -> None:
+        cmd = safe_store.get_if_exists(txn_id)
+        if cmd is None or cmd.save_status.is_truncated \
+                or cmd.has_been(Status.PRE_COMMITTED):
+            return
+        C.truncate(safe_store, cmd, Cleanup.ERASE)
+
+    node.for_each_local(route, txn_id.epoch, txn_id.epoch, for_store)
 
 
 def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,
@@ -439,6 +465,13 @@ def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,
             if state["done"]:
                 return
             if isinstance(reply, InvalidateNack):
+                if reply.truncated:
+                    # below the home shard's durable fence: settled — adopt
+                    # the tombstone locally so waiters unblock, report
+                    # Truncated (outcome unknowable here)
+                    adopt_erased(node, txn_id, route)
+                    finish(Truncated(txn_id, "below the durable fence"))
+                    return
                 finish(Preempted(txn_id, "invalidation superseded"
                                  if not reply.committed else "txn committed"))
                 return

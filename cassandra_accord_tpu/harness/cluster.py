@@ -179,6 +179,9 @@ class SimMessageSink(MessageSink):
         cluster.route(self.node_id, to, request, msg_id, callback is not None)
 
     def reply(self, to: int, reply_context, reply: Reply) -> None:
+        from ..messages.base import LOCAL_NO_REPLY
+        if reply_context is LOCAL_NO_REPLY:
+            return   # self-delivered local request: nothing to answer
         self.cluster.route_reply(self.node_id, to, reply_context, reply)
 
     # -- inbound correlation -------------------------------------------------

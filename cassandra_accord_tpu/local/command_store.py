@@ -171,9 +171,7 @@ class SafeCommandStore:
 
     # -- commands -----------------------------------------------------------
     def get_or_create(self, txn_id: TxnId) -> Command:
-        cmd = self.store.commands.get(txn_id)
-        if cmd is None and txn_id in self.store.cold:
-            cmd = self._fault_in(txn_id)
+        cmd = self.store.lookup(txn_id)
         if cmd is None:
             cmd = Command(txn_id)
             self.store.commands[txn_id] = cmd
@@ -194,7 +192,7 @@ class SafeCommandStore:
         cmd = store.commands.get(txn_id)
         if cmd is None or store.journal is None:
             return False
-        from .status import SaveStatus as _SS, Status as _S
+        from .status import SaveStatus as _SS
         terminal = cmd.save_status in (_SS.APPLIED, _SS.INVALIDATED) \
             or cmd.save_status.is_truncated
         if not terminal:

@@ -69,7 +69,7 @@ def _register_all() -> None:
         (r, ["Route"]),
         (d, ["KeyDeps", "RangeDeps", "Deps"]),
         (ld, ["LatestDeps", "LatestEntry"]),
-        (gdm, ["GetDeps", "GetDepsOk"]),
+        (gdm, ["GetDeps", "GetDepsOk", "GetMaxConflict", "GetMaxConflictOk"]),
         (tx, ["Txn", "PartialTxn", "Writes"]),
         (spp, ["SyncPoint"]),
         (ls, ["ListRead", "ListRangeRead", "ListUpdate", "ListWrite",
@@ -82,7 +82,8 @@ def _register_all() -> None:
               "ApplyOk", "PreAccept", "Accept", "Commit", "ReadTxnData", "Apply",
               "WaitUntilApplied"]),
         (rm, None),
-        (sm, ["CheckStatusOk", "CheckStatus", "InformOfTxn", "InformDurable"]),
+        (sm, ["CheckStatusOk", "CheckStatus", "InformOfTxn", "InformDurable",
+              "InformHomeDurable", "Propagate"]),
         (dm, ["SetShardDurable", "SetGloballyDurable", "DurableBeforeReply",
               "QueryDurableBefore"]),
         (em, ["GetEphemeralReadDepsOk", "GetEphemeralReadDeps",

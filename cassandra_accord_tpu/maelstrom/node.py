@@ -171,6 +171,9 @@ class PacketSink(MessageSink):
                                     "payload": codec.encode_message(request)}))
 
     def reply(self, to: int, reply_context, reply) -> None:
+        from ..messages.base import LOCAL_NO_REPLY
+        if reply_context is LOCAL_NO_REPLY:
+            return   # self-delivered local request (Propagate): no reply
         amsg_id = reply_context
         self.emit(self._packet(to, {"type": "accord_reply", "in_reply_to_a": amsg_id,
                                     "payload": codec.encode_message(reply)}))

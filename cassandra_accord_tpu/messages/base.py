@@ -112,6 +112,18 @@ class Request(Message):
         return None
 
 
+class _LocalNoReply:
+    """Reply context for self-delivered LOCAL requests (Propagate family):
+    sinks drop any reply addressed to it."""
+    __slots__ = ()
+
+    def __repr__(self):
+        return "LOCAL_NO_REPLY"
+
+
+LOCAL_NO_REPLY = _LocalNoReply()
+
+
 class Reply(Message):
     __slots__ = ()
 

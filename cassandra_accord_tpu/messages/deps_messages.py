@@ -78,3 +78,63 @@ class GetDeps(TxnRequest):
 
     def __repr__(self):
         return f"GetDeps({self.txn_id!r}, @{self.execute_at!r})"
+
+
+class GetMaxConflictOk(Reply):
+    __slots__ = ("max_conflict",)
+
+    def __init__(self, max_conflict: Optional[Timestamp]):
+        self.max_conflict = max_conflict
+
+    @property
+    def type(self):
+        return MessageType.GET_MAX_CONFLICT_RSP
+
+    def __repr__(self):
+        return f"GetMaxConflictOk({self.max_conflict!r})"
+
+
+class GetMaxConflict(TxnRequest):
+    """The standalone MaxConflicts consult (GetMaxConflict.java): the highest
+    txnId/executeAt witnessed intersecting a footprint — lets an exclusive
+    sync point (or any coordinator that only needs an ordering bound) learn a
+    safe timestamp floor without a full PreAccept round."""
+
+    __slots__ = ("keys",)
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int, keys):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.keys = keys
+
+    @property
+    def type(self):
+        return MessageType.GET_MAX_CONFLICT_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        keys, scope = self.keys, self.scope
+
+        def map_fn(safe_store):
+            ks = None if isinstance(keys, Ranges) else keys
+            rs = keys if isinstance(keys, Ranges) else None
+            return safe_store.max_conflict(ks, rs)
+
+        def reduce_fn(a, b):
+            if a is None:
+                return b
+            if b is None:
+                return a
+            return a if a > b else b
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(
+                    from_node, reply_context, failure)
+            else:
+                node.reply(from_node, reply_context, GetMaxConflictOk(result))
+
+        node.map_reduce_consume_local(scope, node.topology.min_epoch,
+                                      self.txn_id.epoch, map_fn, reduce_fn) \
+            .begin(consume)
+
+    def __repr__(self):
+        return f"GetMaxConflict({self.txn_id!r})"

@@ -42,10 +42,14 @@ class SetShardDurable(Request):
             safe_store.mark_shard_durable(txn_id, ranges)
 
         from .txn_messages import SIMPLE_OK
-        node.for_each_local(ranges, txn_id.epoch, txn_id.epoch, for_store).begin(
-            lambda _v, f: node.message_sink.reply_with_unknown_failure(
-                from_node, reply_context, f) if f is not None
-            else node.reply(from_node, reply_context, SIMPLE_OK))
+        # for_each_local is EAGER: it returns a settled-able AsyncResult, not
+        # a chain — listen, don't begin (a .begin here crashed every
+        # SetShardDurable, silently failing shard-durable rounds)
+        node.for_each_local(ranges, txn_id.epoch, txn_id.epoch, for_store) \
+            .add_listener(
+                lambda _v, f: node.message_sink.reply_with_unknown_failure(
+                    from_node, reply_context, f) if f is not None
+                else node.reply(from_node, reply_context, SIMPLE_OK))
 
     def __repr__(self):
         return f"SetShardDurable({self.txn_id!r}, {self.ranges!r})"
@@ -72,8 +76,9 @@ class SetGloballyDurable(Request):
             safe_store.merge_durable_before(durable_before)
 
         from .txn_messages import SIMPLE_OK
+        # for_each_local is EAGER (AsyncResult): listen, don't begin
         node.for_each_local(None, node.topology.min_epoch, node.epoch(),
-                            for_store).begin(
+                            for_store).add_listener(
             lambda _v, f: node.message_sink.reply_with_unknown_failure(
                 from_node, reply_context, f) if f is not None
             else node.reply(from_node, reply_context, SIMPLE_OK))

@@ -328,20 +328,28 @@ class InvalidateOk(Reply):
 
 
 class InvalidateNack(Reply):
-    """Rejected: a higher ballot holds the promise, or the txn is already
-    (pre)committed and can no longer be invalidated."""
-    __slots__ = ("superseded_by", "committed")
+    """Rejected: a higher ballot holds the promise (``superseded_by``), the
+    txn is already (pre)committed (``committed``), or it sits below this home
+    shard's durable fence (``truncated`` — NOT a commit claim: a below-fence
+    txn is SETTLED, having either durably applied everywhere that matters and
+    been erased, or being forever unable to newly commit since preaccept
+    below the fence refuses; conflating this with 'committed' sent
+    invalidation into a permanent preempt loop)."""
+    __slots__ = ("superseded_by", "committed", "truncated")
 
-    def __init__(self, superseded_by: Optional[Ballot], committed: bool = False):
+    def __init__(self, superseded_by: Optional[Ballot], committed: bool = False,
+                 truncated: bool = False):
         self.superseded_by = superseded_by
         self.committed = committed
+        self.truncated = truncated
 
     @property
     def type(self):
         return MessageType.BEGIN_INVALIDATE_RSP
 
     def __repr__(self):
-        return f"InvalidateNack(committed={self.committed})"
+        return (f"InvalidateNack(committed={self.committed}, "
+                f"truncated={self.truncated})")
 
 
 class AcceptInvalidate(TxnRequest):
@@ -365,7 +373,10 @@ class AcceptInvalidate(TxnRequest):
             command = safe_store.get_if_exists(txn_id)
             if outcome is C.AcceptOutcome.REJECTED_BALLOT:
                 return InvalidateNack(command.promised)
-            if outcome in (C.AcceptOutcome.REDUNDANT, C.AcceptOutcome.TRUNCATED):
+            if outcome is C.AcceptOutcome.TRUNCATED:
+                # below this shard's durable fence: SETTLED, not committed
+                return InvalidateNack(None, truncated=True)
+            if outcome is C.AcceptOutcome.REDUNDANT:
                 return InvalidateNack(None, committed=True)
             return InvalidateOk(command.status, command.route,
                                 has_definition=command.partial_txn is not None)

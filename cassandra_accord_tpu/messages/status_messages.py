@@ -22,7 +22,7 @@ from ..primitives.deps import Deps
 from ..primitives.route import Route
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import PartialTxn, Txn, Writes
-from .base import MessageType, Reply, TxnRequest
+from .base import MessageType, Reply, Request, TxnRequest
 
 if TYPE_CHECKING:
     from ..local.node import Node
@@ -351,3 +351,76 @@ class InformDurable(TxnRequest):
 
     def __repr__(self):
         return f"InformDurable({self.txn_id!r}, {self.durability.name})"
+
+
+class InformHomeDurable(TxnRequest):
+    """Durability notice to the HOME shard specifically
+    (InformHomeDurable.java): the home shard owns global progress
+    responsibility for the txn (MaybeRecover/home-shard progress state), so
+    it learns durably-applied status even when it holds no data for the txn —
+    standing its progress machinery down."""
+
+    __slots__ = ("execute_at", "durability")
+
+    def __init__(self, txn_id: TxnId, scope: Route, wait_for_epoch: int,
+                 execute_at: Optional[Timestamp], durability: Durability):
+        super().__init__(txn_id, scope, wait_for_epoch)
+        self.execute_at = execute_at
+        self.durability = durability
+
+    @property
+    def type(self):
+        return MessageType.INFORM_HOME_DURABLE_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        txn_id, scope, execute_at, durability = \
+            self.txn_id, self.scope, self.execute_at, self.durability
+
+        def for_store(safe_store: SafeCommandStore) -> None:
+            # durability mark only: the progress log consults durability on
+            # its own cadence.  An EXPLICIT stand-down here would kill local
+            # progress driving on a home replica that has not itself applied
+            # yet (quorum-durable elsewhere ≠ locally complete) — that
+            # variant stalled hostile burns to the probe cap.
+            C.set_durability(safe_store, txn_id, durability, scope, execute_at)
+
+        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store)
+
+    def __repr__(self):
+        return f"InformHomeDurable({self.txn_id!r}, {self.durability.name})"
+
+
+class Propagate(Request):
+    """Knowledge propagation as a FIRST-CLASS local request
+    (Propagate.java:1-546): the merged CheckStatusOk a fetch produced, applied
+    to the local stores by self-delivery through the normal receive path — so
+    it is serializable, shows up in message traces with its own PROPAGATE_*
+    type, and is replayable like any other request."""
+
+    __slots__ = ("txn_id", "merged")
+
+    MESSAGE_TYPES = (MessageType.PROPAGATE_PRE_ACCEPT_MSG,
+                     MessageType.PROPAGATE_STABLE_MSG,
+                     MessageType.PROPAGATE_APPLY_MSG,
+                     MessageType.PROPAGATE_OTHER_MSG)
+
+    def __init__(self, txn_id: TxnId, merged: CheckStatusOk):
+        self.txn_id = txn_id
+        self.merged = merged
+
+    @property
+    def type(self):
+        ss = self.merged.save_status
+        if ss.ordinal >= SaveStatus.PRE_APPLIED.ordinal and not ss.is_truncated:
+            return MessageType.PROPAGATE_APPLY_MSG
+        if ss.has_been(Status.STABLE) and not ss.is_truncated:
+            return MessageType.PROPAGATE_STABLE_MSG
+        if ss.has_been(Status.PRE_ACCEPTED):
+            return MessageType.PROPAGATE_PRE_ACCEPT_MSG
+        return MessageType.PROPAGATE_OTHER_MSG
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        propagate_knowledge(node, self.txn_id, self.merged)
+
+    def __repr__(self):
+        return f"Propagate({self.txn_id!r}, {self.merged.save_status.name})"
