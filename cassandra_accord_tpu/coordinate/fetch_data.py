@@ -12,7 +12,7 @@ from __future__ import annotations
 from typing import TYPE_CHECKING, Callable, Optional
 
 from ..messages.base import Callback, TxnRequest
-from ..messages.status_messages import CheckStatus, CheckStatusOk, propagate_knowledge
+from ..messages.status_messages import CheckStatus, CheckStatusOk
 from ..primitives.route import Route
 from ..primitives.timestamp import TxnId
 from ..utils import async_ as au
@@ -75,14 +75,21 @@ def fetch_data(node: "Node", txn_id: TxnId, route: Route) -> au.AsyncResult:
             target_route = merged.route if merged.route is not None else route
             merged.route = target_route
             # apply as a first-class LOCAL request (serializable, typed,
-            # replayable — Propagate.java), delivered SYNCHRONOUSLY before
+            # replayable — Propagate.java), processed SYNCHRONOUSLY before
             # the result settles: every fetch_data listener relies on the
             # fetched knowledge being applied locally when it fires (a
             # queued self-send would leave the progress log checking
-            # pre-propagation state and spuriously escalating to recovery)
+            # pre-propagation state and spuriously escalating to recovery).
+            # Processed directly — NOT via node.receive, whose catch-all
+            # would swallow an application failure and let the result settle
+            # success over un-applied knowledge.
             from ..messages.base import LOCAL_NO_REPLY
             from ..messages.status_messages import Propagate
-            node.receive(Propagate(txn_id, merged), node.id, LOCAL_NO_REPLY)
+            try:
+                Propagate(txn_id, merged).process(node, node.id, LOCAL_NO_REPLY)
+            except BaseException as e:  # noqa: BLE001
+                result.set_failure(e)
+                return
         result.set_success(merged)
 
     check_status_quorum(node, txn_id, route, include_info=True) \

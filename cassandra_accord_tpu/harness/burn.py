@@ -411,9 +411,17 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
 
 def reconcile(seed: int, **kwargs) -> None:
     """Run the same seed twice and assert identical observable behavior —
-    catches nondeterminism itself (BurnTest.reconcile, ReconcilingLogger)."""
-    a = run_burn(seed, **kwargs)
-    b = run_burn(seed, **kwargs)
+    the COMPLETE message traces (every SEND/DROP/RPLY/RECV with its logical
+    sequence number), plus outcome counters and message stats.  Catches
+    nondeterminism itself (BurnTest.reconcile, ReconcilingLogger)."""
+    from .trace import Trace, diff_traces
+    ta, tb = Trace(), Trace()
+    a = run_burn(seed, tracer=ta.hook, **kwargs)
+    b = run_burn(seed, tracer=tb.hook, **kwargs)
+    divergence = diff_traces(ta, tb)
+    assert divergence is None, \
+        f"nondeterministic trace for seed {seed} " \
+        f"({len(ta)} vs {len(tb)} events):\n{divergence}"
     assert (a.ops_ok, a.ops_recovered, a.ops_nacked, a.ops_lost, a.ops_failed,
             a.sim_micros) == \
            (b.ops_ok, b.ops_recovered, b.ops_nacked, b.ops_lost, b.ops_failed,

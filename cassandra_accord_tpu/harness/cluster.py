@@ -462,13 +462,20 @@ class Cluster:
         if self.batch_window_us > 0:
             self._inbox_deliver(to_node, request, from_node, ctx, latency)
         else:
-            self.queue.add_after(latency, lambda: self.nodes[to_node].receive(
-                request, from_node, ctx))
+            self.queue.add_after(latency, lambda: self._deliver(
+                to_node, request, from_node, ctx))
         if action == LinkConfig.DELIVER_WITH_FAILURE and has_callback:
             self.queue.add_after(
                 self.link.latency_us(from_node, to_node),
                 lambda: self.sinks[from_node].report_failure(
                     msg_id, to_node, ConnectionError(f"link {from_node}->{to_node}")))
+
+    def _deliver(self, to_node: int, request: Request, from_node: int,
+                 ctx: "ReplyContext") -> None:
+        if self.tracer is not None:
+            self.tracer("RECV", from_node, to_node, ctx.msg_id, request,
+                        self.queue.now_micros)
+        self.nodes[to_node].receive(request, from_node, ctx)
 
     def route_reply(self, from_node: int, to_node: int, reply_context: ReplyContext,
                     reply: Reply) -> None:
@@ -481,8 +488,14 @@ class Cluster:
         if action in (LinkConfig.DROP, LinkConfig.FAILURE):
             return
         latency = 0 if from_node == to_node else self.link.latency_us(from_node, to_node)
-        self.queue.add_after(latency, lambda: self.sinks[to_node].deliver_reply(
-            from_node, reply_context.msg_id, reply))
+
+        def deliver():
+            if self.tracer is not None:
+                self.tracer("RECV_RPLY", from_node, to_node,
+                            reply_context.msg_id, reply, self.queue.now_micros)
+            self.sinks[to_node].deliver_reply(from_node, reply_context.msg_id,
+                                              reply)
+        self.queue.add_after(latency, deliver)
 
     def _count(self, key: str) -> None:
         self.stats[key] = self.stats.get(key, 0) + 1
@@ -545,6 +558,9 @@ class Cluster:
             store.resolver.prefetch(specs)
         try:
             for (_at, _seq, request, frm, ctx), _h in with_specs:
+                if self.tracer is not None:
+                    self.tracer("RECV", frm, to_node, ctx.msg_id, request,
+                                self.queue.now_micros)
                 node.receive(request, frm, ctx)
         finally:
             for store in per_store:

@@ -1,0 +1,61 @@
+"""Full message tracing + trace reconciliation.
+
+Capability parity with ``accord.impl.basic.Trace`` and the burn's
+``ReconcilingLogger`` (Cluster.java:237-264, burn/ReconcilingLogger.java):
+every network event — SEND (with the link action taken: DELIVER / DROP /
+FAILURE / DELIVER_WITH_FAILURE), reply routing (RPLY_*), and the actual
+delivery (RECV / RECV_RPLY) — is recorded with a logical sequence number.
+``reconcile`` then runs the same seed twice and diffs the COMPLETE traces,
+not summary scalars: any nondeterminism in the simulation (iteration order,
+uncontrolled randomness, wall-clock leakage) surfaces as the first
+divergent event.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+def _brief(message) -> str:
+    """A compact, deterministic description: class + primary txn id."""
+    name = type(message).__name__
+    tid = getattr(message, "txn_id", None)
+    return f"{name}({tid})" if tid is not None else name
+
+
+class Trace:
+    """Recorder for one run; install via ``cluster.tracer = trace.hook`` —
+    the cluster calls the hook for SEND/RPLY routing decisions and RECV
+    deliveries."""
+
+    __slots__ = ("events", "_seq")
+
+    def __init__(self):
+        self.events: List[Tuple] = []
+        self._seq = 0
+
+    def hook(self, event: str, frm: int, to: int, msg_id, message,
+             now_micros: int) -> None:
+        self.events.append((self._seq, now_micros, event, frm, to, msg_id,
+                            _brief(message)))
+        self._seq += 1
+
+    def __len__(self):
+        return len(self.events)
+
+
+def diff_traces(a: Trace, b: Trace) -> Optional[str]:
+    """None if identical; else a report of the first divergence with
+    surrounding context."""
+    n = min(len(a.events), len(b.events))
+    for i in range(n):
+        if a.events[i] != b.events[i]:
+            lo = max(0, i - 3)
+            ctx_a = "\n".join(f"  a[{j}]: {a.events[j]}" for j in range(lo, min(i + 2, len(a.events))))
+            ctx_b = "\n".join(f"  b[{j}]: {b.events[j]}" for j in range(lo, min(i + 2, len(b.events))))
+            return (f"traces diverge at event {i}:\n{ctx_a}\n  --- vs ---\n{ctx_b}")
+    if len(a.events) != len(b.events):
+        i = n
+        tail = (a if len(a.events) > n else b).events[n:n + 3]
+        return (f"trace lengths differ: {len(a.events)} vs {len(b.events)}; "
+                f"first extra events: {tail}")
+    return None

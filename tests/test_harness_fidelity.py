@@ -77,3 +77,20 @@ def test_burn_all_faults_with_journal():
 def test_reconcile_determinism():
     reconcile(9, ops=60, concurrency=6)
     reconcile(9, ops=60, concurrency=6, delayed_stores=True, clock_drift=True)
+
+
+def test_reconcile_diffs_full_traces():
+    """reconcile compares COMPLETE message traces (not summary scalars):
+    hostile-config double-runs must produce byte-identical event sequences,
+    and an artificial divergence must be pinpointed."""
+    from cassandra_accord_tpu.harness.burn import reconcile
+    from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+    reconcile(777, ops=40, concurrency=6, chaos=True, allow_failures=True,
+              durability=True, journal=True, max_tasks=2_000_000)
+    # the differ pinpoints the first divergent event
+    a, b = Trace(), Trace()
+    for i in range(5):
+        a.hook("SEND", 1, 2, i, object(), 100 + i)
+        b.hook("SEND", 1, 2, i if i != 3 else 99, object(), 100 + i)
+    report = diff_traces(a, b)
+    assert report is not None and "event 3" in report
