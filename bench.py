@@ -31,6 +31,8 @@ import numpy as np
 
 os.environ.setdefault("ACCORD_TPU_TXN_SLOTS", "1024")
 os.environ.setdefault("ACCORD_TPU_KEY_SLOTS", "64")
+os.environ.setdefault("ACCORD_TPU_WALK_MAX", "512")   # tuned: cost-ladder knee
+TPU_WINDOW_US = 5_000                                  # tuned delivery window
 
 
 # ---------------------------------------------------------------------------
@@ -218,8 +220,8 @@ def bench_graph(t=8192, iters=3):
 
 def main():
     # warm the jit caches so protocol timing measures steady state, not compiles
-    bench_protocol("tpu", batch_window_us=3_000, ops=40, reps=1)
-    tpu_cps, tpu_res = bench_protocol("tpu", batch_window_us=3_000)
+    bench_protocol("tpu", batch_window_us=TPU_WINDOW_US, ops=40, reps=1)
+    tpu_cps, tpu_res = bench_protocol("tpu", batch_window_us=TPU_WINDOW_US)
     cpu_cps, cpu_res = bench_protocol("cpu", batch_window_us=0)
     assert tpu_res.ops_ok == cpu_res.ops_ok, "workload mismatch"
     tel = {k: v for k, v in tpu_res.stats.items() if k.startswith("resolver_")}
@@ -249,7 +251,7 @@ def main():
             "protocol_commits_per_sec_cpu_resolver": round(cpu_cps, 1),
             "workload": {"ops": PROTO_OPS, "concurrency": PROTO_CONC,
                          **PROTO_KW, "seed": PROTO_SEED,
-                         "tpu_batch_window_us": 3000},
+                         "tpu_batch_window_us": TPU_WINDOW_US},
             "tpu_resolver_telemetry": tel,
             "kernel_scaling": kernels,
             "graph_kernels": graph,

@@ -435,3 +435,52 @@ def reconcile(seed: int, **kwargs) -> None:
     assert sa == sb, \
         f"nondeterministic message counts for seed {seed}: " \
         f"{ {k: (sa.get(k), sb.get(k)) for k in set(sa) | set(sb) if sa.get(k) != sb.get(k)} }"
+
+
+def main(argv=None) -> None:
+    """Long-running burn entry point (the reference's BurnTest main:
+    ``python -m cassandra_accord_tpu.harness.burn --seeds 0:100 --ops 1000``).
+    Every seed runs the full hostile matrix by default; any violation raises
+    SimulationException with the seed for replay."""
+    import argparse
+    import time as _time
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--seeds", default="0:10",
+                   help="seed or lo:hi range (default 0:10)")
+    p.add_argument("--ops", type=int, default=1000)
+    p.add_argument("--concurrency", type=int, default=20)
+    p.add_argument("--rf", type=int, default=None,
+                   help="replication factor (default: seeded 2-9)")
+    p.add_argument("--nodes", type=int, default=None)
+    p.add_argument("--resolver", default=None,
+                   choices=[None, "cpu", "tpu", "verify"])
+    p.add_argument("--benign", action="store_true",
+                   help="disable the chaos network")
+    p.add_argument("--no-cache-miss", action="store_true")
+    p.add_argument("--reconcile", action="store_true",
+                   help="double-run each seed and diff full traces")
+    args = p.parse_args(argv)
+    lo, _, hi = args.seeds.partition(":")
+    seeds = range(int(lo), int(hi) + 1) if hi else [int(lo)]
+    for seed in seeds:
+        rf = args.rf if args.rf is not None else 2 + RandomSource(seed).next_int(8)
+        kw = dict(ops=args.ops, concurrency=args.concurrency, rf=rf,
+                  nodes=args.nodes, resolver=args.resolver,
+                  chaos=not args.benign, allow_failures=not args.benign,
+                  durability=True, journal=True,
+                  delayed_stores=not args.benign, clock_drift=not args.benign,
+                  cache_miss=not args.no_cache_miss,
+                  max_tasks=200_000_000)
+        t0 = _time.perf_counter()
+        if args.reconcile:
+            reconcile(seed, **kw)
+            print(f"seed {seed}: reconciled (rf={rf}, "
+                  f"{_time.perf_counter() - t0:.1f}s)")
+        else:
+            result = run_burn(seed, **kw)
+            print(f"seed {seed}: {result!r} (rf={rf}, "
+                  f"{_time.perf_counter() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

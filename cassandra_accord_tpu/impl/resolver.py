@@ -99,6 +99,16 @@ class DepsResolver:
         """An edge drained (dep applied/invalidated/truncated or provably
         ordered after the waiter — Commands.java:704-775)."""
 
+    def _durable_majority(self, rk: RoutingKey) -> Optional[TxnId]:
+        """The key's majority-durable watermark — the elision soundness gate
+        (cfk.map_reduce_active doc).  Shared by BOTH data planes: the gate
+        semantics must stay bit-identical for verify parity."""
+        db = getattr(self.store, "durable_before", None)
+        if db is None:
+            return None
+        e = db.entry(rk)
+        return e.majority_before if e is not None else None
+
     def register(self, txn_id: TxnId, status: "InternalStatus",
                  execute_at: Optional[Timestamp],
                  keys: Tuple[RoutingKey, ...]) -> None:
@@ -154,7 +164,8 @@ class CpuDepsResolver(DepsResolver):
             cfk = self.store.cfks.get(rk)
             if cfk is not None:
                 cfk.map_reduce_active(before, by.witnesses,
-                                      lambda t, _rk=rk: out.append((_rk, t)))
+                                      lambda t, _rk=rk: out.append((_rk, t)),
+                                      durable_majority=self._durable_majority(rk))
         return out
 
     def range_conflicts(self, by, rng, before):
@@ -163,7 +174,8 @@ class CpuDepsResolver(DepsResolver):
             if rng.contains(rk):
                 cfk = self.store.cfks[rk]
                 cfk.map_reduce_active(before, by.witnesses,
-                                      lambda t, _rk=rk: out.append((_rk, t)))
+                                      lambda t, _rk=rk: out.append((_rk, t)),
+                                      durable_majority=self._durable_majority(rk))
         return out
 
     def max_conflict_keys(self, keys):

@@ -183,6 +183,10 @@ class TpuDepsResolver(DepsResolver):
         self.key_cw: Dict[RoutingKey, Dict[TxnId, Timestamp]] = {}
         self.key_uncovered: Dict[RoutingKey, Set[TxnId]] = {}
         self.key_covered: Dict[RoutingKey, Set[TxnId]] = {}
+        # elision soundness gate (cfk.map_reduce_active doc): a txn may only
+        # be covered once below the key's MAJORITY-durable watermark; the
+        # store bumps durable_gen on watermark advances and we re-sweep lazily
+        self._durable_gen_seen = -1
         # pending (txn_id) inserts/updates, (slot, key_slot) bit ops, and
         # chronological live-matrix ops (cover=0 / uncover=1)
         self._dirty_txns: Set[TxnId] = set()
@@ -282,6 +286,20 @@ class TpuDepsResolver(DepsResolver):
             # on the new keys too (same lattice-entry handling, new keys only)
             self._on_committed(txn_id, m)
 
+    def _coverable_now(self, rk: RoutingKey, txn_id: TxnId, m: _TxnMirror,
+                       e_k: Optional[Timestamp] = None,
+                       bound: Optional[TxnId] = None) -> bool:
+        """Cover condition = below the covering write AND below the key's
+        majority-durable watermark (the elision soundness gate).  Callers in
+        loops pass the hoisted per-key e_k/bound."""
+        if e_k is None:
+            e_k = self.key_maxw.get(rk)
+        if e_k is None or not m.execute_at < e_k:
+            return False
+        if bound is None:
+            bound = self._durable_majority(rk)
+        return bound is not None and txn_id < bound
+
     def _on_committed(self, txn_id: TxnId, m: _TxnMirror) -> None:
         """The txn entered the committed lattice (executeAt now final):
         maintain the covering bounds and covered bits (cfk elision mirror)."""
@@ -293,11 +311,11 @@ class TpuDepsResolver(DepsResolver):
                 continue    # this key already processed (added-keys re-entry)
             if rk in self.key_covered and txn_id in self.key_covered[rk]:
                 continue
-            e_k = self.key_maxw.get(rk)
-            if coverable and e_k is not None and m.execute_at < e_k:
+            if coverable and self._coverable_now(rk, txn_id, m):
                 self._cover(rk, txn_id, m)
             elif coverable:
                 self.key_uncovered.setdefault(rk, set()).add(txn_id)
+            e_k = self.key_maxw.get(rk)
             if is_w:
                 self.key_cw.setdefault(rk, {})[txn_id] = m.execute_at
                 if self._cache is not None:
@@ -310,16 +328,33 @@ class TpuDepsResolver(DepsResolver):
                     self._sweep(rk)
 
     def _sweep(self, rk: RoutingKey) -> None:
-        """E_k advanced: cover every committed coverable txn now below it."""
-        e_k = self.key_maxw[rk]
+        """The covering bound (E_k or the durability gate) advanced: cover
+        every committed coverable txn now below both."""
         unc = self.key_uncovered.get(rk)
         if not unc:
             return
+        e_k = self.key_maxw.get(rk)
+        bound = self._durable_majority(rk)       # loop-invariant: hoisted
+        if e_k is None or bound is None:
+            return
         for t in list(unc):
             mt = self.txns.get(t)
-            if mt is not None and mt.execute_at < e_k:
+            if mt is not None and self._coverable_now(rk, t, mt, e_k, bound):
                 unc.discard(t)
                 self._cover(rk, t, mt)
+
+    def _maybe_resweep_durable(self) -> None:
+        """The store's durability watermarks advanced since we last looked:
+        the elision gate may have widened — re-sweep keys with uncovered
+        committed entries (lazy, amortised against durable_gen)."""
+        gen = getattr(self.store, "durable_gen", None)
+        if gen is None or gen == self._durable_gen_seen:
+            return
+        self._durable_gen_seen = gen
+        self._cache = None   # cached answers predate the wider gate
+        for rk in list(self.key_uncovered):
+            if self.key_uncovered.get(rk):
+                self._sweep(rk)
 
     def _cover(self, rk: RoutingKey, txn_id: TxnId, m: _TxnMirror) -> None:
         m.covered.add(rk)
@@ -408,6 +443,7 @@ class TpuDepsResolver(DepsResolver):
         answers for the window (see module doc for the exactness rules).
         Specs whose bound is at/below a queried key's covering bound take the
         exact per-key slow path instead of the batched matmul."""
+        self._maybe_resweep_durable()   # BEFORE the cache is built
         if self._use_walk():
             # below the vectorization threshold the walk answers each query
             # cheaper than a batch pass + cache bookkeeping
@@ -616,6 +652,9 @@ class TpuDepsResolver(DepsResolver):
 
     # -- queries -------------------------------------------------------------
     def key_conflicts(self, by: TxnId, keys, before: Timestamp):
+        # O(1) gen probe BEFORE any cached hit: a mid-window durability
+        # advance widens the elision gate and invalidates prefetched answers
+        self._maybe_resweep_durable()
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
             return []
@@ -654,6 +693,7 @@ class TpuDepsResolver(DepsResolver):
         return self.key_conflicts(by, keys, before)
 
     def max_conflict_keys(self, keys) -> Optional[Timestamp]:
+        self._maybe_resweep_durable()   # see key_conflicts
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
             return None
@@ -824,9 +864,12 @@ class TpuDepsResolver(DepsResolver):
             col = h["key_inc"][:, self.key_slot[rk]] != 0
             cand = col & started & wit & eligible
             cw = col & committed & is_w & ea_before
-            if cw.any():
+            bound = self._durable_majority(rk)
+            if cw.any() and bound is not None:
                 maxcw = _lex_max_rows(h["ts"][cw])
-                elide = committed & _lex_less(h["ts"], maxcw) & write_wit
+                bound_lanes = np.asarray(_pack_before(bound), dtype=np.int64)
+                elide = committed & _lex_less(h["ts"], maxcw) & write_wit \
+                    & _lex_less(h["txn_id"], bound_lanes)
                 cand = cand & ~elide
             for slot in np.nonzero(cand)[0]:
                 tid = self.txn_at.get(int(slot))
@@ -913,6 +956,7 @@ class TpuDepsResolver(DepsResolver):
         """Apply buffered mutations to the canonical host arrays (in-place row
         writes — O(rows changed)); the device copy goes stale and re-syncs
         lazily if/when the device tier is next chosen."""
+        self._maybe_resweep_durable()
         if self._h is None:
             self._rebuild()
             return

@@ -188,11 +188,26 @@ class CommandsForKey:
 
     # -- dependency calculation (the HOT query; CommandsForKey.java:925-1000) ----
     def map_reduce_active(self, before: Timestamp, witnesses: Callable[[TxnId], bool],
-                          fn: Callable[[TxnId], None]) -> None:
+                          fn: Callable[[TxnId], None],
+                          durable_majority: Optional[TxnId] = None) -> None:
         """Visit every active managed txn with txnId < before that the caller's
         kind witnesses — MINUS committed txns transitively covered by the
         latest committed write executing before the bound (elision, module
-        doc).  This is the PreAccept/Accept deps query."""
+        doc).  This is the PreAccept/Accept deps query.
+
+        SOUNDNESS GATE (stronger than the reference, whose elision carries an
+        unresolved 'prove the correctness of this approach' TODO,
+        CommandsForKey.java:956): a txn is elided only when ALSO below
+        ``durable_majority`` — the majority-durable watermark for this key.
+        Elision removes the txn from later deps, which poisons per-replica
+        recovery evidence ('T executed after ours WITHOUT witnessing us'
+        would reject our fast path, BeginRecovery.java:329-380) at replicas
+        where the elided txn is still undecided.  Majority durability restores
+        the quorum argument: every recovery quorum then intersects a replica
+        holding the txn APPLIED, so its agreed outcome is always discovered
+        before any fast-path deciphering.  The hostile burn demonstrated the
+        violation (a fast-committed range read invalidated by elision-poisoned
+        evidence) before this gate."""
         maxcw = self.max_committed_write_before(before)
         for info in self.by_id:
             if info.txn_id >= before:
@@ -204,6 +219,8 @@ class CommandsForKey:
             if not witnesses(info.txn_id):
                 continue
             if maxcw is not None and st in _DECIDED \
+                    and durable_majority is not None \
+                    and info.txn_id < durable_majority \
                     and info.execute_at < maxcw \
                     and TxnKind.WRITE.witnesses(info.txn_id.kind):
                 continue    # ordered (and witnessed) by the covering write

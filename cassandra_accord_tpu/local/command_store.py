@@ -76,6 +76,9 @@ class CommandStore:
         self.pending_bootstrap: Ranges = Ranges.EMPTY
         # optional persistence hook (harness Journal; simulated durability)
         self.journal = None
+        # bumped on every durability-watermark advance: the resolvers'
+        # elision gates re-evaluate lazily against it
+        self.durable_gen = 0
         # cache-miss plane (PreLoadContext.java / DelayedCommandStores
         # cache-miss injection): ids whose command state was EVICTED from
         # memory and lives only in the journal; faulted back in on access
@@ -400,11 +403,13 @@ class SafeCommandStore:
                                  universal_before=txn_id))
             self.store.redundant_before = self.store.redundant_before.merge(
                 RedundantBefore.of(local, shard_applied_before=txn_id))
+            self.store.durable_gen += 1   # elision gate may have widened
         self.run_gc()
 
     def merge_durable_before(self, durable_before) -> None:
         """SetGloballyDurable: adopt a cluster-wide durability watermark map."""
         self.store.durable_before = self.store.durable_before.merge(durable_before)
+        self.store.durable_gen += 1       # elision gate may have widened
         self.run_gc()
 
     def run_gc(self) -> None:

@@ -49,6 +49,7 @@ class Node(ConfigurationService.Listener):
         self.resolver_kind = resolver if resolver is not None \
             else resolver_kind_from_env()
         self.topology = TopologyManager(node_id)
+        self._epoch_watchdogs: set = set()
         self.command_stores = CommandStores(self, num_shards, executor_factory)
         self._progress_log_factory = progress_log_factory
         self._exclusive_sync_point_listeners: List[Callable] = []
@@ -147,12 +148,38 @@ class Node(ConfigurationService.Listener):
     def truncate_topology_until(self, epoch: int) -> None:
         self.topology.truncate_until(epoch)
 
+    # epoch-fetch watchdog: re-request an awaited epoch on this cadence, and
+    # give up (failing the waiters) after this many attempts — an unreachable
+    # configuration service must not stall epoch-gated work forever
+    # (TopologyManager.java fetch watchdog / LocalConfig epoch timeouts)
+    EPOCH_FETCH_RETRY_S = 1.0
+    EPOCH_FETCH_ATTEMPTS = 30
+
     def with_epoch(self, epoch: int) -> au.AsyncChain:
         """Await local knowledge of ``epoch`` (Node.java:289-322)."""
         if self.topology.has_epoch(epoch):
             return au.done(None)
         self.config_service.fetch_topology_for_epoch(epoch)
+        if epoch not in self._epoch_watchdogs:
+            self._epoch_watchdogs.add(epoch)
+            self._arm_epoch_watchdog(epoch, 0)
         return self.topology.await_epoch(epoch).to_chain()
+
+    def _arm_epoch_watchdog(self, epoch: int, attempts: int) -> None:
+        def check():
+            if self.topology.has_epoch(epoch):
+                self._epoch_watchdogs.discard(epoch)
+                return
+            if attempts + 1 >= self.EPOCH_FETCH_ATTEMPTS:
+                self._epoch_watchdogs.discard(epoch)
+                from ..coordinate.errors import Timeout
+                self.topology.fail_epoch_waiters(
+                    epoch, Timeout(None, f"epoch {epoch} unobtainable "
+                                   f"after {attempts + 1} fetch attempts"))
+                return
+            self.config_service.fetch_topology_for_epoch(epoch)
+            self._arm_epoch_watchdog(epoch, attempts + 1)
+        self.scheduler.once(self.EPOCH_FETCH_RETRY_S, check)
 
     # -- coordination entry points (Node.java:573+) ---------------------------
     def coordinate(self, txn: Txn, txn_id: Optional[TxnId] = None) -> au.AsyncResult:

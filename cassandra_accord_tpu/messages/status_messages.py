@@ -22,7 +22,7 @@ from ..primitives.deps import Deps
 from ..primitives.route import Route
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import PartialTxn, Txn, Writes
-from .base import MessageType, Reply, Request, TxnRequest
+from .base import Callback, MessageType, Reply, Request, TxnRequest
 
 if TYPE_CHECKING:
     from ..local.node import Node
@@ -261,10 +261,23 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk) -> N
             writes_free = not txn_id.is_write   # sync points / reads: applying
             if writes_free or (merged.writes is not None                # is a no-op
                                and merged.applied_for.contains_all(local_parts_t)):
+                was_waiting = command.waiting_on is not None \
+                    and command.waiting_on.is_waiting()
+                never_initialised = command.waiting_on is None \
+                    and not command.save_status.has_been(Status.STABLE)
                 C.adopt_truncated_outcome(safe_store, command, route,
                                           merged.execute_at,
                                           None if writes_free else merged.writes,
                                           merged.result)
+                if was_waiting or never_initialised:
+                    # this replica adopted an outcome WITHOUT having applied
+                    # the txn's (truncated-away) predecessors: their writes
+                    # will never arrive individually — heal the data gap with
+                    # a peer snapshot of the affected keys (timestamp-sorted,
+                    # idempotent append: the union subsumes every missing
+                    # predecessor; the hostile 1000-op burn caught replicas
+                    # diverging with holes exactly here)
+                    _heal_store_gaps(node, safe_store, local_parts_t)
             return
         # gate each tier on the merged knowledge actually covering THIS store's
         # slice of the route (the reference's Known.sufficientFor per-store gate,
@@ -290,6 +303,54 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk) -> N
             C.preaccept(safe_store, txn_id, merged.partial_txn, route)
 
     node.for_each_local(route, txn_id.epoch, max_epoch, for_store)
+
+
+def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
+                     participants) -> None:
+    """Snapshot-fetch ``participants``' data from peer replicas and merge
+    (idempotent, timestamp-ordered).  Sources may themselves lag — merging
+    every reply is safe, and at least one replica past the durable fence
+    (the one whose truncated evidence triggered this) holds the full set."""
+    from ..primitives.keys import Ranges as _Rs
+    from .fetch_messages import FetchStoreData, FetchStoreDataOk
+    rngs = participants if isinstance(participants, _Rs) \
+        else participants.to_ranges()
+    if not len(rngs):
+        return
+    store = node.data_store
+    topology = node.config_service.current_topology()
+    targets = set()
+    for shard in topology.shards:
+        if rngs.intersects(_Rs.of(shard.range)):
+            targets.update(n for n in shard.nodes if n != node.id)
+
+    def attempt(remaining_tries: int) -> None:
+        state = {"pending": len(targets), "healed": False}
+
+        class HealCallback(Callback):
+            def on_success(self, from_node: int, reply) -> None:
+                state["pending"] -= 1
+                if isinstance(reply, FetchStoreDataOk):
+                    state["healed"] = True
+                    for key, entries in reply.entries.items():
+                        for ts, value in entries:
+                            store.append(key, ts, value)
+
+            def on_failure(self, from_node: int, failure: BaseException) -> None:
+                state["pending"] -= 1
+                if state["pending"] == 0 and not state["healed"] \
+                        and remaining_tries > 1:
+                    # EVERY peer failed (chaos): the gap is still open —
+                    # retry after a beat; the complete peer exists, its
+                    # reply was just lost
+                    node.scheduler.once(1.0, lambda: attempt(remaining_tries - 1))
+
+        callback = HealCallback()
+        for to in sorted(targets):
+            node.send(to, FetchStoreData(rngs), callback)
+
+    if targets:
+        attempt(5)
 
 
 # ---------------------------------------------------------------------------

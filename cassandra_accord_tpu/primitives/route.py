@@ -82,7 +82,15 @@ class Route:
 
     def union(self, other: "Route") -> "Route":
         check_state(self.home_key == other.home_key, "cannot union routes with different homeKeys")
-        u = self.unseekables.union(other.unseekables)
+        a, b = self.unseekables, other.unseekables
+        # mixed domains (a key-backed partial meeting the range-backed real
+        # route): lift keys to their unit covering ranges — unioning the raw
+        # containers would corrupt the route
+        if isinstance(a, Ranges) and isinstance(b, RoutingKeys):
+            b = b.to_ranges()
+        elif isinstance(a, RoutingKeys) and isinstance(b, Ranges):
+            a = a.to_ranges()
+        u = a.union(b)
         full = self.full or other.full
         covering = None
         if not full and self.covering is not None and other.covering is not None:
@@ -95,6 +103,13 @@ class Route:
         return self
 
     def home_key_only(self) -> "Route":
+        """A partial route claiming only the home key — in the SAME domain as
+        this route: a range-domain txn's home-only route must stay
+        range-backed, or a later CheckStatusOk.merge unioning it with the
+        real route mixes keys into ranges and corrupts the route."""
+        if isinstance(self.unseekables, Ranges):
+            only = RoutingKeys.of([self.home_key]).to_ranges()
+            return Route(self.home_key, only, full=False)
         return Route(self.home_key, RoutingKeys.of([self.home_key]), full=False)
 
     def is_empty(self) -> bool:

@@ -158,6 +158,14 @@ class TopologyManager:
         self._awaiting.setdefault(epoch, []).append(s)
         return s
 
+    def fail_epoch_waiters(self, epoch: int, failure: BaseException) -> None:
+        """The epoch-fetch watchdog gave up (configuration service
+        unreachable): fail every waiter so gated work errors out instead of
+        stalling forever (TopologyManager.java epoch-fetch watchdog)."""
+        for s in self._awaiting.pop(epoch, []):
+            if not s.is_done():
+                s.set_failure(failure)
+
     # -- coordination selection (TopologyManager.java:513+) ------------------
     def precise_epochs(self, unseekables, min_epoch: int, max_epoch: int) -> Topologies:
         """Topologies over [min_epoch, max_epoch], each trimmed to the shards

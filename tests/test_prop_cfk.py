@@ -46,11 +46,12 @@ def _play(events):
     return cfk, model
 
 
-def _naive_active(model, before, by_kind):
+def _naive_active(model, before, by_kind, durable_majority=None):
     """The reference mapReduceActive semantics recomputed from scratch:
-    witness filter, invalidated/TK skip, and transitive elision below the
-    max committed WRITE executing before the bound
-    (CommandsForKey.java:925-986)."""
+    witness filter, invalidated/TK skip, and transitive elision below BOTH
+    the max committed WRITE executing before the bound
+    (CommandsForKey.java:925-986) AND the majority-durable watermark (the
+    soundness gate, cfk.map_reduce_active doc)."""
     maxcw = None
     for tid, (status, ea) in model.items():
         if status in _DECIDED and tid.is_write and ea < before:
@@ -65,22 +66,27 @@ def _naive_active(model, before, by_kind):
             continue
         if not by_kind.witnesses(tid.kind):
             continue
-        if maxcw is not None and status in _DECIDED and ea < maxcw \
-                and TxnKind.WRITE.witnesses(tid.kind):
+        if maxcw is not None and status in _DECIDED \
+                and durable_majority is not None and tid < durable_majority \
+                and ea < maxcw and TxnKind.WRITE.witnesses(tid.kind):
             continue
         out.add(tid)
     return out
 
 
 @prop.for_all(_EVENTS, prop.ints(0, 250),
-              prop.pick([TxnKind.WRITE, TxnKind.READ]), tries=3000)
-def test_map_reduce_active_matches_naive(events, before_hlc, by_kind):
+              prop.pick([TxnKind.WRITE, TxnKind.READ]),
+              prop.ints(0, 300), tries=3000)
+def test_map_reduce_active_matches_naive(events, before_hlc, by_kind, dur_hlc):
     cfk, model = _play(events)
     before = Timestamp(1, before_hlc, 5)
     by = TxnId(1, before_hlc, 5, by_kind, Domain.KEY)
+    # durability gate: absent for a third of cases, else a generated bound
+    bound = None if dur_hlc % 3 == 0 else TxnId(1, dur_hlc, 9)
     got = set()
-    cfk.map_reduce_active(before, by.witnesses, got.add)
-    assert got == _naive_active(model, before, by_kind)
+    cfk.map_reduce_active(before, by.witnesses, got.add,
+                          durable_majority=bound)
+    assert got == _naive_active(model, before, by_kind, bound)
 
 
 @prop.for_all(_EVENTS, tries=3000)
@@ -110,9 +116,11 @@ def test_prune_guard_and_requery(events, bound_hlc):
         del model[tid]
     before = Timestamp(1, 300, 9)
     by = TxnId(1, 300, 9, TxnKind.WRITE, Domain.KEY)
+    bound = TxnId(1, 280, 9)
     got = set()
-    cfk.map_reduce_active(before, by.witnesses, got.add)
-    assert got == _naive_active(model, before, by.kind)
+    cfk.map_reduce_active(before, by.witnesses, got.add,
+                          durable_majority=bound)
+    assert got == _naive_active(model, before, by.kind, bound)
 
 
 @prop.for_all(_EVENTS, tries=2000)

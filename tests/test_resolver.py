@@ -34,10 +34,19 @@ def tid(hlc, node=1, kind=TxnKind.WRITE):
 
 
 class _FakeStore:
-    """Minimal stand-in exposing .cfks for the CPU resolver."""
+    """Minimal stand-in exposing .cfks (+ durability watermarks: the elision
+    soundness gate) for the CPU resolver."""
 
     def __init__(self):
+        from cassandra_accord_tpu.local.durability import DurableBefore
+        from cassandra_accord_tpu.primitives.keys import Ranges as _Rs
         self.cfks = {}
+        # a high majority watermark over the whole keyspace: these unit tests
+        # exercise elision mechanics, not the durability protocol
+        self.durable_before = DurableBefore.of(
+            _Rs.of(Range(k(0), k(100000))),
+            majority_before=tid(1 << 40), universal_before=None)
+        self.durable_gen = 0
 
     def cfk(self, key):
         from cassandra_accord_tpu.local.cfk import CommandsForKey

@@ -142,3 +142,17 @@ def test_burn_with_topology_churn():
         res = run_burn(seed, ops=100, concurrency=8, topology_churn=True,
                        churn_interval_s=0.3)
         assert res.ops_ok == 100, res
+
+
+def test_epoch_fetch_watchdog_fails_unobtainable_epoch():
+    """An unreachable/never-advancing configuration service must not stall
+    epoch-gated work forever: the fetch watchdog retries, then fails the
+    waiters (TopologyManager fetch-watchdog capability)."""
+    from cassandra_accord_tpu.coordinate.errors import Timeout as AccordTimeout
+    shards = [Shard(Range(IntKey(0), IntKey(1000)), [1, 2, 3])]
+    cluster = Cluster(Topology(1, shards), seed=5)
+    node = cluster.nodes[1]
+    got = {}
+    node.with_epoch(99).begin(lambda v, f: got.setdefault("f", f))
+    assert cluster.run_until(lambda: "f" in got)
+    assert isinstance(got["f"], AccordTimeout)
