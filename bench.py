@@ -46,7 +46,11 @@ PROTO_SEED = 7
 # consult (one vectorized pass / one MXU launch per delivery window) is flat
 PROTO_OPS = 2000
 PROTO_CONC = 64
-PROTO_KW = dict(nodes=3, rf=3, key_count=6, num_shards=1)
+# durability=True: scheduled durability rounds advance the majority
+# watermarks that GATE transitive elision (the soundness gate) — without
+# them deps grow O(history) and the bench measures an unrealistic regime
+# (real deployments always run durability; GC depends on it)
+PROTO_KW = dict(nodes=3, rf=3, key_count=6, num_shards=1, durability=True)
 
 
 def bench_protocol(resolver: str, batch_window_us: int, ops: int = PROTO_OPS,
