@@ -44,7 +44,7 @@ PROTO_SEED = 7
 # chains): per-key histories grow into the thousands, where the
 # reference-shaped per-key walk scans O(history) per query and the array
 # consult (one vectorized pass / one MXU launch per delivery window) is flat
-PROTO_OPS = 2000
+PROTO_OPS = 1200
 PROTO_CONC = 64
 # durability=True: scheduled durability rounds advance the majority
 # watermarks that GATE transitive elision (the soundness gate) — without
