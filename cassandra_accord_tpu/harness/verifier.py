@@ -95,6 +95,93 @@ class StrictSerializabilityVerifier:
         self._check_real_time(done, orders)
         self._check_atomicity(done)
         self._check_invalidated_never_applied(done, final_state)
+        self._check_serialization_graph(done, orders)
+
+    # -- 5: serialization-graph acyclicity (the Elle core) --------------------
+    def _check_serialization_graph(self, done: List["Observation"],
+                                   orders: Dict[Key, Tuple]) -> None:
+        """Build the full dependency graph over acked ops and reject cycles
+        (the reference pairs its verifier with Elle, verify/ElleVerifier.java;
+        this is Elle's list-append core):
+
+        - ww: per-key version order (the unique-value list positions);
+        - wr: a read observing version v depends on v's writer;
+        - rw (anti-dependency): a read observing length L precedes the writer
+          of position L (it did not see that write);
+        - rt: A completed before B was submitted => A precedes B.
+
+        A cycle = the acked outcomes admit NO strict-serializable order, even
+        when every per-key/per-pair check above passes."""
+        pos: Dict[Key, Dict[object, int]] = {
+            key: {v: i for i, v in enumerate(order)}
+            for key, order in orders.items()}
+        writer_of: Dict[Tuple[Key, int], int] = {}
+        op_index: Dict[int, Observation] = {o.op_id: o for o in done}
+        for o in done:
+            for key, value in o.writes.items():
+                p = pos.get(key, {}).get(value)
+                if p is not None:
+                    writer_of[(key, p)] = o.op_id
+        edges: Dict[int, set] = {o.op_id: set() for o in done}
+
+        def add(a: int, b: int) -> None:
+            if a != b and a in edges and b in op_index:
+                edges[a].add(b)
+
+        # ww: successive versions of a key
+        for (key, p), writer in writer_of.items():
+            nxt = writer_of.get((key, p + 1))
+            if nxt is not None:
+                add(writer, nxt)
+        for o in done:
+            for key, lst in o.reads.items():
+                # wr: the last version this read observed precedes it
+                if lst:
+                    w = writer_of.get((key, len(lst) - 1))
+                    if w is not None:
+                        add(w, o.op_id)
+                # rw: the first version it did NOT observe follows it
+                w = writer_of.get((key, len(lst)))
+                if w is not None:
+                    add(o.op_id, w)
+        # rt: real-time precedence — bisect to the first op submitted after
+        # a's completion; everything from there qualifies
+        from bisect import bisect_right
+        ordered = sorted(done, key=lambda o: o.submit_time)
+        submits = [o.submit_time for o in ordered]
+        for a in done:
+            if a.complete_time is None:
+                continue
+            for b in ordered[bisect_right(submits, a.complete_time):]:
+                add(a.op_id, b.op_id)
+        # cycle detection (iterative three-color DFS)
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color = {op: WHITE for op in edges}
+        for root in edges:
+            if color[root] != WHITE:
+                continue
+            stack = [(root, iter(edges[root]))]
+            color[root] = GRAY
+            path = [root]
+            while stack:
+                node, it = stack[-1]
+                advanced = False
+                for nxt in it:
+                    if color[nxt] == GRAY:
+                        i = path.index(nxt)
+                        raise HistoryViolation(
+                            f"serialization-graph cycle: {path[i:] + [nxt]} — "
+                            f"acked outcomes admit no strict-serializable order")
+                    if color[nxt] == WHITE:
+                        color[nxt] = GRAY
+                        stack.append((nxt, iter(edges[nxt])))
+                        path.append(nxt)
+                        advanced = True
+                        break
+                if not advanced:
+                    color[node] = BLACK
+                    stack.pop()
+                    path.pop()
 
     # -- 0: every op resolved ------------------------------------------------
     def _check_response_accounting(self) -> None:

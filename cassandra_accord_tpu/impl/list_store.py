@@ -23,6 +23,39 @@ class ListStore(DataStore):
     def __init__(self, node_id: int = 0):
         self.node_id = node_id
         self.data: Dict[Key, List[Tuple[Timestamp, object]]] = {}
+        # ranges with KNOWN data holes (truncated-outcome adoption landed a
+        # txn whose truncated-away predecessors are absent): reads here are
+        # refused (obsolete-nack -> coordinator retries another replica)
+        # until a peer snapshot heals the gap
+        # a MULTISET of marks: overlapping gaps from independent heals must
+        # not clear each other's coverage (each heal clears only its token)
+        self._stale_marks: list = []
+
+    def mark_stale(self, rngs):
+        """Returns the token to pass to clear_stale."""
+        token = rngs
+        self._stale_marks.append(token)
+        return token
+
+    def clear_stale(self, token) -> None:
+        try:
+            self._stale_marks.remove(token)
+        except ValueError:
+            pass
+
+    @property
+    def stale_ranges(self):
+        from ..primitives.keys import Ranges as _Ranges
+        out = _Ranges.EMPTY
+        for r in self._stale_marks:
+            out = out.union(r)
+        return out
+
+    def is_stale(self, key) -> bool:
+        if not self._stale_marks:
+            return False
+        rk = key.to_routing() if hasattr(key, "to_routing") else key
+        return any(r.contains(rk) for r in self._stale_marks)
 
     def get(self, key: Key) -> Tuple[object, ...]:
         return tuple(v for _, v in self.data.get(key, ()))
@@ -179,6 +212,8 @@ class ListRead(Read):
         return self._keys
 
     def read(self, key, safe_store, execute_at, data_store) -> au.AsyncChain:
+        if getattr(data_store, "is_stale", lambda _k: False)(key):
+            return au.done("obsolete")   # gapped here: serve from a peer
         return au.done(ListData({key: data_store.get_at(key, execute_at)}))
 
     def slice(self, ranges: Ranges) -> "ListRead":
@@ -199,6 +234,9 @@ class ListRangeRead(Read):
         return self._ranges
 
     def read(self, rng, safe_store, execute_at, data_store) -> au.AsyncChain:
+        stale = getattr(data_store, "stale_ranges", None)
+        if stale is not None and len(stale) and stale.intersects(rng):
+            return au.done("obsolete")   # gapped here: serve from a peer
         entries = {key: data_store.get_at(key, execute_at)
                    for key in data_store.keys_in(rng)}
         return au.done(ListData(entries))

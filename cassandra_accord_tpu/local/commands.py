@@ -556,8 +556,30 @@ def _apply_writes(safe_store: SafeCommandStore, command: Command) -> None:
 def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
     """Apply a Cleanup decision: strip payloads, downgrade to a truncated
     SaveStatus.  TRUNCATE_WITH_OUTCOME keeps writes/result for peers that may
-    still need the outcome; ERASE drops everything but the tombstone."""
+    still need the outcome; ERASE drops everything but the tombstone.
+
+    DATA-GAP GUARD: truncating a WRITE that never applied LOCALLY leaves a
+    hole in this replica's data (waiters drop the dep and execute without its
+    writes; the cluster truncated it so its Apply will never arrive) — the
+    store is marked stale over the txn's local footprint (reads redirect to
+    peers) and a peer-snapshot heal is scheduled.  The hostile 1000-op burns
+    caught readers observing the hole without this."""
     from .durability import Cleanup
+    if command.txn_id.is_write and not command.has_been(Status.APPLIED) \
+            and command.save_status is not SaveStatus.INVALIDATED \
+            and command.route is not None:
+        local_parts = command.route.participants().slice(
+            safe_store.current_ranges())
+        if len(local_parts):
+            if command.writes is not None and command.execute_at is not None:
+                # the outcome is retained (TRUNCATE_WITH_OUTCOME arriving
+                # here, or an adopted outcome): land its OWN writes locally
+                # before anything else — no network needed for this txn's gap
+                command.writes.apply_to(safe_store, safe_store.store.all_ranges())
+            # predecessors may be missing too (that is WHY this txn never
+            # applied): stale-mark + peer-snapshot heal over the footprint
+            from ..messages.status_messages import _heal_store_gaps
+            _heal_store_gaps(safe_store.store.node, safe_store, local_parts)
     if command.save_status is SaveStatus.INVALIDATED:
         # invalidation is terminal: strip any payloads left from earlier phases
         command.partial_txn = None

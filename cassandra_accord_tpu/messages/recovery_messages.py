@@ -204,26 +204,44 @@ def recovery_evidence(safe_store: SafeCommandStore, txn_id: TxnId, keys):
     for command, footprint in _scan_conflicting(safe_store, txn_id, keys):
         other = command.txn_id
         status = command.status
-        witnessed_us = command.partial_deps is not None and command.partial_deps.contains(txn_id)
+        # SOUNDNESS: 'did not witness us' is only evidence when the command's
+        # DECIDED deps are actually present.  A deps-less command
+        # (PRE_COMMITTED stores no deps; truncation strips them) must not be
+        # read as a non-witness — the hostile 1000-op burns caught recovery
+        # invalidating a FAST-COMMITTED txn off exactly that misreading.
+        # (The fast-path argument needs real deps: any fast quorum of ours
+        # intersects the other txn's preaccept quorum in a member that voted
+        # for us first, so its decided deps MUST contain us.)
+        deps_known = command.partial_deps is not None
+        witnessed_us = deps_known and command.partial_deps.contains(txn_id)
         is_proposed = status in (Status.ACCEPTED, Status.PRE_COMMITTED, Status.COMMITTED)
         is_stable = (status.has_been(Status.STABLE)
                      and not command.save_status.is_truncated
                      and command.save_status is not SaveStatus.INVALIDATED)
-        if not witnessed_us:
+        if deps_known and not witnessed_us:
             # started after ours and accepted/committed => our fast path cannot
             # have reached a quorum (its deps calc would have witnessed us)
             if other > txn_id and is_proposed:
                 rejects_fast_path = True
-            # decided to execute after ours without witnessing us
-            if is_stable and command.execute_at is not None \
+            # decided to execute after ours without witnessing us — EXCEPT
+            # awaits-only-deps kinds (exclusive sync points): they never agree
+            # an execution time and only take deps on LOWER txnIds, so one
+            # executing after us structurally cannot have witnessed us and
+            # proves nothing about our fast path (the hostile burns caught an
+            # ESP's evidence invalidating a fast-committed write here)
+            if is_stable and not other.awaits_only_deps \
+                    and command.execute_at is not None \
                     and command.execute_at > txn_id.as_timestamp():
                 rejects_fast_path = True
         if other < txn_id:
             if is_stable and witnessed_us:
                 _add_overlap(ecw, other, footprint, keys)
             elif is_proposed and not witnessed_us \
+                    and not other.awaits_only_deps \
                     and command.execute_at is not None \
                     and command.execute_at > txn_id.as_timestamp():
+                # (awaits-only-deps kinds excluded: they cannot witness a
+                # higher txnId, so waiting for them to commit decides nothing)
                 _add_overlap(eanw, other, footprint, keys)
     return rejects_fast_path, ecw.build(), eanw.build()
 

@@ -324,8 +324,25 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
         if rngs.intersects(_Rs.of(shard.range)):
             targets.update(n for n in shard.nodes if n != node.id)
 
-    def attempt(remaining_tries: int) -> None:
+    if not targets:
+        return   # no peer can heal (lone replica): marking stale would
+                 # permanently refuse reads with nothing to redirect to
+    token = store.mark_stale(rngs)   # reads redirect until the gap heals
+
+    def attempt() -> None:
         state = {"pending": len(targets), "healed": False}
+
+        def complete() -> None:
+            """All replies in (success or failure): one shared epilogue —
+            clearing must not depend on WHICH callback arrives last."""
+            if state["healed"]:
+                store.clear_stale(token)
+            else:
+                # every peer failed (chaos): the gap is still open and a
+                # complete peer exists (its reply was lost) — keep trying at
+                # a low cadence; partitions re-roll, so availability returns
+                # without ever re-exposing the hole
+                node.scheduler.once(2.0, attempt)
 
         class HealCallback(Callback):
             def on_success(self, from_node: int, reply) -> None:
@@ -335,22 +352,19 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
                     for key, entries in reply.entries.items():
                         for ts, value in entries:
                             store.append(key, ts, value)
+                if state["pending"] == 0:
+                    complete()
 
             def on_failure(self, from_node: int, failure: BaseException) -> None:
                 state["pending"] -= 1
-                if state["pending"] == 0 and not state["healed"] \
-                        and remaining_tries > 1:
-                    # EVERY peer failed (chaos): the gap is still open —
-                    # retry after a beat; the complete peer exists, its
-                    # reply was just lost
-                    node.scheduler.once(1.0, lambda: attempt(remaining_tries - 1))
+                if state["pending"] == 0:
+                    complete()
 
         callback = HealCallback()
         for to in sorted(targets):
             node.send(to, FetchStoreData(rngs), callback)
 
-    if targets:
-        attempt(5)
+    attempt()
 
 
 # ---------------------------------------------------------------------------
