@@ -174,6 +174,19 @@ class _Recover:
             self.await_commits(eanw)
             return
 
+        lnw = Deps.merge([ok.later_unknown_witness for ok in oks])
+        if not lnw.is_empty():
+            # LATER-started in-flight conflicts whose deps are undecided:
+            # completing the fast path at txnId is only sound once every
+            # later-started conflicting COMMIT provably witnessed us — wait
+            # for them to settle, then re-examine (their decided deps either
+            # include us, or become rule-1 fast-path-rejection evidence and
+            # we invalidate).  The superseding race (KNOWN_ISSUES seed 112):
+            # without this wait, recovery completed a fast path that a
+            # later fast-committed conflict had already ordered around.
+            self.await_commits(lnw)
+            return
+
         # the fast path may have committed: complete it at executeAt = txnId
         self.done = True
         resume_propose(self.node, self.txn_id, self.txn, self.route, self.result,

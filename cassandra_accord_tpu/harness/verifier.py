@@ -144,16 +144,26 @@ class StrictSerializabilityVerifier:
                 w = writer_of.get((key, len(lst)))
                 if w is not None:
                     add(o.op_id, w)
-        # rt: real-time precedence — bisect to the first op submitted after
-        # a's completion; everything from there qualifies
+        # rt: real-time precedence in O(n) edges via a virtual submit chain:
+        # v_j precedes op_j and v_{j+1}; a -> v_j for the first j submitted
+        # after a's completion.  Paths a -> v_j -> ... -> op_k encode exactly
+        # 'a completed before op_k was submitted' with no spurious op-op
+        # constraints (the dense O(n^2) pair relation blew up verify time)
         from bisect import bisect_right
         ordered = sorted(done, key=lambda o: o.submit_time)
         submits = [o.submit_time for o in ordered]
+        for j, o in enumerate(ordered):
+            vj = ("rt", j)
+            edges[vj] = set()
+            edges[vj].add(o.op_id)
+            if j + 1 < len(ordered):
+                edges[vj].add(("rt", j + 1))
         for a in done:
             if a.complete_time is None:
                 continue
-            for b in ordered[bisect_right(submits, a.complete_time):]:
-                add(a.op_id, b.op_id)
+            j = bisect_right(submits, a.complete_time)
+            if j < len(ordered):
+                edges[a.op_id].add(("rt", j))
         # cycle detection (iterative three-color DFS)
         WHITE, GRAY, BLACK = 0, 1, 2
         color = {op: WHITE for op in edges}

@@ -565,7 +565,11 @@ def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
     peers) and a peer-snapshot heal is scheduled.  The hostile 1000-op burns
     caught readers observing the hole without this."""
     from .durability import Cleanup
-    if command.txn_id.is_write and not command.has_been(Status.APPLIED) \
+    # committed-or-later only: truncating a NEVER-COMMITTED write (the
+    # below-fence settled/erased case) leaves no hole — no writes exist
+    # anywhere — and must not refuse reads or launch heals
+    if command.txn_id.is_write and command.has_been(Status.PRE_COMMITTED) \
+            and not command.has_been(Status.APPLIED) \
             and command.save_status is not SaveStatus.INVALIDATED \
             and command.route is not None:
         local_parts = command.route.participants().slice(

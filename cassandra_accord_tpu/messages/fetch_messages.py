@@ -66,6 +66,16 @@ class FetchStoreData(Request):
                     from_node, reply_context,
                     RuntimeError("source bootstrapping requested ranges"))
                 return
+        # likewise a source with its OWN known data gaps on these ranges
+        # (stale marks): serving its snapshot would 'heal' the fetcher with
+        # the same hole and clear the fetcher's stale mark over an open gap
+        src_stale = getattr(node.data_store, "stale_ranges", None)
+        if src_stale is not None and len(src_stale) \
+                and src_stale.intersects(self.ranges):
+            node.message_sink.reply_with_unknown_failure(
+                from_node, reply_context,
+                RuntimeError("source has stale (gapped) data on requested ranges"))
+            return
 
         def serve(outcome=None, failure=None) -> None:
             if failure is not None:

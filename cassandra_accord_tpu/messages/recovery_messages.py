@@ -44,12 +44,14 @@ if TYPE_CHECKING:
 class RecoverOk(Reply):
     __slots__ = ("txn_id", "status", "accepted", "execute_at", "deps",
                  "earlier_committed_witness", "earlier_accepted_no_witness",
-                 "rejects_fast_path", "writes", "result")
+                 "later_unknown_witness", "rejects_fast_path", "writes",
+                 "result")
 
     def __init__(self, txn_id: TxnId, status: Status, accepted: Ballot,
                  execute_at: Optional[Timestamp], deps: LatestDeps,
                  earlier_committed_witness: Deps, earlier_accepted_no_witness: Deps,
-                 rejects_fast_path: bool, writes, result):
+                 rejects_fast_path: bool, writes, result,
+                 later_unknown_witness: Deps = Deps.NONE):
         self.txn_id = txn_id
         self.status = status
         self.accepted = accepted
@@ -57,6 +59,7 @@ class RecoverOk(Reply):
         self.deps = deps
         self.earlier_committed_witness = earlier_committed_witness
         self.earlier_accepted_no_witness = earlier_accepted_no_witness
+        self.later_unknown_witness = later_unknown_witness
         self.rejects_fast_path = rejects_fast_path
         self.writes = writes
         self.result = result
@@ -84,7 +87,9 @@ class RecoverOk(Reply):
         return RecoverOk(a.txn_id, a.status, a.accepted, execute_at,
                          a.deps.merge(b.deps), ecw, eanw,
                          a.rejects_fast_path or b.rejects_fast_path,
-                         a.writes, b.result if a.result is None else a.result)
+                         a.writes, b.result if a.result is None else a.result,
+                         later_unknown_witness=a.later_unknown_witness
+                         .with_merged(b.later_unknown_witness))
 
     def __repr__(self):
         return (f"RecoverOk({self.txn_id!r}, {self.status.name}, acc={self.accepted!r},"
@@ -197,10 +202,12 @@ def _scan_conflicting(safe_store: SafeCommandStore, txn_id: TxnId, keys):
 
 def recovery_evidence(safe_store: SafeCommandStore, txn_id: TxnId, keys):
     """Compute (rejects_fast_path, earlier_committed_witness,
-    earlier_accepted_no_witness) for a pre-accepted-only txn."""
+    earlier_accepted_no_witness, later_unknown_witness) for a
+    pre-accepted-only txn."""
     rejects_fast_path = False
     ecw = DepsBuilder()
     eanw = DepsBuilder()
+    lnw = DepsBuilder()
     for command, footprint in _scan_conflicting(safe_store, txn_id, keys):
         other = command.txn_id
         status = command.status
@@ -243,7 +250,20 @@ def recovery_evidence(safe_store: SafeCommandStore, txn_id: TxnId, keys):
                 # (awaits-only-deps kinds excluded: they cannot witness a
                 # higher txnId, so waiting for them to commit decides nothing)
                 _add_overlap(eanw, other, footprint, keys)
-    return rejects_fast_path, ecw.build(), eanw.build()
+        elif not deps_known and not other.awaits_only_deps \
+                and status.has_been(Status.PRE_ACCEPTED) \
+                and command.save_status is not SaveStatus.INVALIDATED \
+                and not command.save_status.is_truncated:
+            # LATER-started conflict whose witness status is UNKNOWN here
+            # (in flight: no decided deps yet).  Completing our fast path at
+            # txnId is only sound if every later-started conflicting COMMIT
+            # witnessed us — which cannot be established while such txns are
+            # unsettled (the superseding race, KNOWN_ISSUES seed 112): the
+            # recovery coordinator must wait for them to settle and
+            # re-examine (their decided deps then either witness us or
+            # become rule-1 rejection evidence)
+            _add_overlap(lnw, other, footprint, keys)
+    return rejects_fast_path, ecw.build(), eanw.build(), lnw.build()
 
 
 # ---------------------------------------------------------------------------
@@ -297,12 +317,14 @@ class BeginRecovery(TxnRequest):
                 safe_store.store.ranges_at(txn_id.epoch),
                 known, command.accepted_or_committed, coordinated, local)
             if command.has_been(Status.PRE_COMMITTED):
-                rejects, ecw, eanw = False, Deps.NONE, Deps.NONE
+                rejects, ecw, eanw, lnw = False, Deps.NONE, Deps.NONE, Deps.NONE
             else:
-                rejects, ecw, eanw = recovery_evidence(safe_store, txn_id, partial_txn.keys)
+                rejects, ecw, eanw, lnw = recovery_evidence(
+                    safe_store, txn_id, partial_txn.keys)
             return RecoverOk(txn_id, command.status, command.accepted_or_committed,
                              command.execute_at, deps, ecw, eanw, rejects,
-                             command.writes, command.result)
+                             command.writes, command.result,
+                             later_unknown_witness=lnw)
 
         def reduce_fn(a, b):
             if isinstance(a, RecoverNack):

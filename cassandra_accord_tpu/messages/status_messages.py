@@ -319,52 +319,66 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
         return
     store = node.data_store
     topology = node.config_service.current_topology()
-    targets = set()
+    # PER-SHARD fetch plan: the stale mark may only clear when EVERY shard
+    # slice of the footprint was healed by a replica of THAT shard (one Ok
+    # from a different shard's peer says nothing about this slice)
+    plan = []
     for shard in topology.shards:
-        if rngs.intersects(_Rs.of(shard.range)):
-            targets.update(n for n in shard.nodes if n != node.id)
-
-    if not targets:
+        sub = rngs.intersection(_Rs.of(shard.range))
+        if len(sub):
+            peers = sorted(n for n in shard.nodes if n != node.id)
+            if peers:
+                plan.append((sub, peers))
+    if not plan:
         return   # no peer can heal (lone replica): marking stale would
                  # permanently refuse reads with nothing to redirect to
     token = store.mark_stale(rngs)   # reads redirect until the gap heals
 
-    def attempt() -> None:
-        state = {"pending": len(targets), "healed": False}
+    def attempt(remaining) -> None:
+        state = {"open": list(remaining)}
 
-        def complete() -> None:
-            """All replies in (success or failure): one shared epilogue —
-            clearing must not depend on WHICH callback arrives last."""
-            if state["healed"]:
-                store.clear_stale(token)
-            else:
-                # every peer failed (chaos): the gap is still open and a
-                # complete peer exists (its reply was lost) — keep trying at
-                # a low cadence; partitions re-roll, so availability returns
-                # without ever re-exposing the hole
-                node.scheduler.once(2.0, attempt)
+        def slice_attempt(sub, peers) -> None:
+            st = {"pending": len(peers), "healed": False}
 
-        class HealCallback(Callback):
-            def on_success(self, from_node: int, reply) -> None:
-                state["pending"] -= 1
-                if isinstance(reply, FetchStoreDataOk):
-                    state["healed"] = True
-                    for key, entries in reply.entries.items():
-                        for ts, value in entries:
-                            store.append(key, ts, value)
-                if state["pending"] == 0:
-                    complete()
+            class HealCallback(Callback):
+                def on_success(self, from_node: int, reply) -> None:
+                    st["pending"] -= 1
+                    if isinstance(reply, FetchStoreDataOk):
+                        st["healed"] = True
+                        for key, entries in reply.entries.items():
+                            for ts, value in entries:
+                                store.append(key, ts, value)
+                    if st["pending"] == 0:
+                        done()
 
-            def on_failure(self, from_node: int, failure: BaseException) -> None:
-                state["pending"] -= 1
-                if state["pending"] == 0:
-                    complete()
+                def on_failure(self, from_node: int, failure: BaseException) -> None:
+                    st["pending"] -= 1
+                    if st["pending"] == 0:
+                        done()
 
-        callback = HealCallback()
-        for to in sorted(targets):
-            node.send(to, FetchStoreData(rngs), callback)
+            def done() -> None:
+                """Shared epilogue — not dependent on WHICH reply was last."""
+                if st["healed"]:
+                    state["open"] = [(s, p) for s, p in state["open"]
+                                     if s is not sub]
+                if not state["open"]:
+                    store.clear_stale(token)
+                if not st["healed"]:
+                    # every peer of this shard failed (chaos) or refused
+                    # (their own gaps): keep trying at a low cadence —
+                    # partitions re-roll, so availability returns without
+                    # ever re-exposing the hole
+                    node.scheduler.once(2.0,
+                                        lambda: slice_attempt(sub, peers))
 
-    attempt()
+            callback = HealCallback()
+            for to in peers:
+                node.send(to, FetchStoreData(sub), callback)
+
+        for sub, peers in state["open"]:
+            slice_attempt(sub, peers)
+
+    attempt(plan)
 
 
 # ---------------------------------------------------------------------------

@@ -89,10 +89,11 @@ def test_benign_burn_with_cache_misses_verify_resolver(monkeypatch):
 
 @pytest.mark.skipif("ACCORD_LONG_BURNS" not in __import__("os").environ,
                     reason="~5 min; run with ACCORD_LONG_BURNS=1")
-@pytest.mark.xfail(reason="KNOWN_ISSUES.md: seed 112 — lone-replica apply of "
-                   "a cluster-excluded write (third invalidate-vs-applied "
-                   "race variant, under forensics)", strict=False)
-def test_hostile_burn_seed_112_known_open():
+def test_hostile_burn_seed_112_superseding_race_regression():
+    """KNOWN_ISSUES.md: the superseding race — recovery completing the fast
+    path while a later-started conflict had fast-committed around us.  Fixed
+    by the later-unknown-witness wait; this seed reproduced all three
+    variants of the race family during round 3."""
     run_burn(112, ops=1000, concurrency=20, chaos=True, allow_failures=True,
              durability=True, journal=True, delayed_stores=True,
              clock_drift=True, cache_miss=True, max_tasks=200_000_000)
