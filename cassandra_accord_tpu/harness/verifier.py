@@ -92,14 +92,18 @@ class StrictSerializabilityVerifier:
         done = [o for o in self.observations if o.outcome == "ok"]
         self._check_response_accounting()
         orders = self._check_prefix_consistency(done, final_state)
-        self._check_real_time(done, orders)
-        self._check_atomicity(done)
+        # value -> position inverse index, shared by the three order-sensitive
+        # checks so position semantics live in exactly one place
+        pos = {key: {v: i for i, v in enumerate(order)}
+               for key, order in orders.items()}
+        self._check_real_time(done, pos)
+        self._check_atomicity(done, pos)
         self._check_invalidated_never_applied(done, final_state)
-        self._check_serialization_graph(done, orders)
+        self._check_serialization_graph(done, pos)
 
     # -- 5: serialization-graph acyclicity (the Elle core) --------------------
     def _check_serialization_graph(self, done: List["Observation"],
-                                   orders: Dict[Key, Tuple]) -> None:
+                                   pos: Dict[Key, Dict[object, int]]) -> None:
         """Build the full dependency graph over acked ops and reject cycles
         (the reference pairs its verifier with Elle, verify/ElleVerifier.java;
         this is Elle's list-append core):
@@ -112,9 +116,6 @@ class StrictSerializabilityVerifier:
 
         A cycle = the acked outcomes admit NO strict-serializable order, even
         when every per-key/per-pair check above passes."""
-        pos: Dict[Key, Dict[object, int]] = {
-            key: {v: i for i, v in enumerate(order)}
-            for key, order in orders.items()}
         writer_of: Dict[Tuple[Key, int], int] = {}
         op_index: Dict[int, Observation] = {o.op_id: o for o in done}
         for o in done:
@@ -241,51 +242,142 @@ class StrictSerializabilityVerifier:
         return orders
 
     # -- 2: real-time --------------------------------------------------------
-    def _check_real_time(self, done: List[Observation], orders: Dict[Key, Tuple]) -> None:
-        # index: for each key, value -> position in the longest observed order
-        pos: Dict[Key, Dict[object, int]] = {
-            key: {v: i for i, v in enumerate(order)} for key, order in orders.items()}
-        for a in done:
-            for b in done:
-                if a is b or a.complete_time is None or a.complete_time > b.submit_time:
-                    continue
-                # a completed strictly before b was submitted
-                for key, value in a.writes.items():
-                    if key in b.reads:
-                        if value not in b.reads[key]:
-                            raise HistoryViolation(
-                                f"real-time violation: op {a.op_id} wrote {value!r} to "
-                                f"{key} and completed at {a.complete_time}, but op "
-                                f"{b.op_id} (submitted {b.submit_time}) read {b.reads[key]}")
-                    if key in b.writes and key in pos:
-                        pa = pos[key].get(value)
-                        pb = pos[key].get(b.writes[key])
-                        if pa is not None and pb is not None and pa > pb:
-                            raise HistoryViolation(
-                                f"real-time violation: op {a.op_id}'s write {value!r} "
-                                f"ordered after op {b.op_id}'s {b.writes[key]!r} on {key} "
-                                f"despite completing before it was submitted")
+    def _check_real_time(self, done: List[Observation],
+                         pos: Dict[Key, Dict[object, int]]) -> None:
+        """O(n log n) sweep replacing the dense pair relation (the nested loop
+        bounded burn scale before the protocol did).
+
+        Ops are processed in submit order; ops completed at-or-before the
+        current submit time are folded into per-key aggregates first.  Because
+        prefix consistency has already been verified, ``b.reads[key]`` IS
+        ``orders[key][:L]``, so "a's write visible to b" reduces to
+        ``pos[a's value] < L`` — the aggregate only needs, per key, the max
+        write position among completed ops (with its writer, for the error
+        message) plus any completed writes never observed in the order at all
+        (visible to no one — any later reader of the key violates)."""
+        # per-key aggregates over completed ops:
+        #   top2: the two highest-ordered completed writes BY DISTINCT OPS as
+        #         (position, writer_op, value, complete_time) — two entries so
+        #         a check for op b can exclude b itself (an op's own write may
+        #         already be absorbed when submit/complete times tie), and the
+        #         max over all OTHER ops is still exactly available;
+        #   unordered: [(writer_op, value, complete_time)] completed writes
+        #              absent from the observed order (visible to nobody).
+        top2: Dict[Key, List[Tuple[int, int, object, int]]] = {}
+        unordered: Dict[Key, List[Tuple[int, object, int]]] = {}
+
+        def absorb(a: Observation) -> None:
+            for key, value in a.writes.items():
+                p = pos.get(key, {}).get(value)
+                if p is None:
+                    unordered.setdefault(key, []).append(
+                        (a.op_id, value, a.complete_time))
+                else:
+                    entry = (p, a.op_id, value, a.complete_time)
+                    best = top2.setdefault(key, [])
+                    best.append(entry)
+                    best.sort(reverse=True)
+                    del best[2:]
+
+        def max_excluding(key: Key, op_id: int):
+            for entry in top2.get(key, ()):
+                if entry[1] != op_id:
+                    return entry
+            return None
+
+        by_submit = sorted(done, key=lambda o: o.submit_time)
+        by_complete = sorted((o for o in done if o.complete_time is not None),
+                             key=lambda o: o.complete_time)
+        i = 0
+        for b in by_submit:
+            while i < len(by_complete) and \
+                    by_complete[i].complete_time <= b.submit_time:
+                absorb(by_complete[i])
+                i += 1
+            for key, lst in b.reads.items():
+                ln = len(lst)
+                agg = max_excluding(key, b.op_id)
+                if agg is not None and agg[0] >= ln:
+                    p, writer, value, ct = agg
+                    raise HistoryViolation(
+                        f"real-time violation: op {writer} wrote {value!r} to "
+                        f"{key} and completed at {ct}, but op "
+                        f"{b.op_id} (submitted {b.submit_time}) read {lst}")
+                for writer, value, ct in unordered.get(key, ()):
+                    if writer != b.op_id:
+                        raise HistoryViolation(
+                            f"real-time violation: op {writer} wrote {value!r} "
+                            f"to {key} and completed at {ct}, but op "
+                            f"{b.op_id} (submitted {b.submit_time}) read {lst}")
+            for key, value in b.writes.items():
+                pb = pos.get(key, {}).get(value)
+                agg = max_excluding(key, b.op_id)
+                if pb is not None and agg is not None and agg[0] > pb:
+                    p, writer, wvalue, ct = agg
+                    raise HistoryViolation(
+                        f"real-time violation: op {writer}'s write {wvalue!r} "
+                        f"ordered after op {b.op_id}'s {value!r} on {key} "
+                        f"despite completing before it was submitted")
 
     # -- 3: atomicity --------------------------------------------------------
-    def _check_atomicity(self, done: List[Observation]) -> None:
-        writers: Dict[object, Observation] = {}
+    def _check_atomicity(self, done: List[Observation],
+                         pos: Dict[Key, Dict[object, int]]) -> None:
+        """A fractured read needs a reader observing ≥2 of one writer's keys
+        with mixed visibility, so only (key, key) pairs matter.  With prefix
+        consistency already established, W's write at position p on key k is
+        visible to a reader iff its read length on k exceeds p (never-ordered
+        writes get an infinite position: visible to nobody).  Index every
+        writer's key pairs as (p_i, p_j) points sorted by p_i with a running
+        max of p_j; a reader pair (L_i, L_j) fractures iff some point has
+        p_i < L_i (visible on k_i) and p_j >= L_j (invisible on k_j) — i.e.
+        the prefix-max of p_j over p_i < L_i reaches L_j.  Replaces the
+        reader×writers scan that went quadratic under contention."""
+        INF = float("inf")
+        # (k_i, k_j) -> [(p_i, p_j, writer_op)], both directions
+        pairs: Dict[Tuple[Key, Key], List[Tuple[float, float, int]]] = {}
         for o in done:
-            for key, value in o.writes.items():
-                writers[(key, value)] = o
-        for reader in done:
-            if not reader.reads:
+            if len(o.writes) < 2:
                 continue
-            # visibility of each writer txn to this reader, per shared key
-            seen: Dict[int, List[Tuple[Key, bool]]] = {}
-            for key, lst in reader.reads.items():
-                observed = set(lst)
-                for (wkey, value), writer in writers.items():
-                    if wkey != key or writer is reader:
-                        continue
-                    seen.setdefault(writer.op_id, []).append((key, value in observed))
-            for writer_id, flags in seen.items():
-                states = {f for _, f in flags}
-                if len(states) > 1:
-                    raise HistoryViolation(
-                        f"fractured read: op {reader.op_id} sees only part of op "
-                        f"{writer_id}'s writes: {flags}")
+            wkeys = sorted(o.writes, key=repr)
+            wpos = {k: pos.get(k, {}).get(o.writes[k], INF) for k in wkeys}
+            for idx, ki in enumerate(wkeys):
+                for kj in wkeys[idx + 1:]:
+                    pairs.setdefault((ki, kj), []).append(
+                        (wpos[ki], wpos[kj], o.op_id))
+                    pairs.setdefault((kj, ki), []).append(
+                        (wpos[kj], wpos[ki], o.op_id))
+        index: Dict[Tuple[Key, Key], Tuple[List[float], List[float]]] = {}
+        for pk, pts in pairs.items():
+            pts.sort()
+            prefix_max: List[float] = []
+            best = -1.0
+            for _, pj, _ in pts:
+                best = max(best, pj)
+                prefix_max.append(best)
+            index[pk] = ([pi for pi, _, _ in pts], prefix_max)
+        from bisect import bisect_left
+        for reader in done:
+            if len(reader.reads) < 2:
+                continue
+            rkeys = list(reader.reads)
+            for idx, ki in enumerate(rkeys):
+                li = len(reader.reads[ki])
+                for kj in rkeys[idx + 1:]:
+                    lj = len(reader.reads[kj])
+                    for (ka, la), (kb, lb) in (((ki, li), (kj, lj)),
+                                               ((kj, lj), (ki, li))):
+                        entry = index.get((ka, kb))
+                        if entry is None:
+                            continue
+                        pis, pmax = entry
+                        n = bisect_left(pis, la)  # points with p_i < L_a
+                        if n == 0 or pmax[n - 1] < lb:
+                            continue
+                        # aggregate hit: enumerate culprits, excluding self
+                        for pi, pj, writer in pairs[(ka, kb)]:
+                            if writer != reader.op_id and pi < la and pj >= lb:
+                                raise HistoryViolation(
+                                    f"fractured read: op {reader.op_id} sees op "
+                                    f"{writer}'s write on {ka} (read len {la} > "
+                                    f"pos {pi}) but not on {kb} (read len {lb} "
+                                    f"<= pos {pj})")

@@ -66,6 +66,87 @@ def test_verifier_rejects_unresolved_ops():
         v.verify()
 
 
+def test_verifier_rejects_real_time_write_write_reorder():
+    # a's write ordered AFTER b's despite a completing before b was submitted;
+    # exercises the write-vs-write branch of the sweep aggregate.
+    v = StrictSerializabilityVerifier()
+    a = v.begin(0)
+    a.complete(10, {}, {k(1): "late"})
+    b = v.begin(20)
+    b.complete(30, {}, {k(1): "early"})
+    c = v.begin(40)
+    c.complete(50, {k(1): ("early", "late")}, {})
+    with pytest.raises(HistoryViolation, match="real-time"):
+        v.verify()
+
+
+def test_verifier_rejects_unordered_completed_write():
+    # a's acked write never appears in any observed order; any later reader of
+    # the key is a violation (the 'unordered' aggregate path).
+    v = StrictSerializabilityVerifier()
+    a = v.begin(0)
+    a.complete(10, {}, {k(1): "ghost"})
+    b = v.begin(20)
+    b.complete(30, {k(1): ("other",)}, {})
+    c = v.begin(0)
+    c.complete(5, {}, {k(1): "other"})
+    with pytest.raises(HistoryViolation, match="real-time"):
+        v.verify()
+
+
+def test_verifier_tied_timestamps_not_self_violating():
+    # an op whose complete_time ties another op's submit_time must never be
+    # counted against itself by the real-time sweep (zero-duration ops under
+    # tied simulated clocks).
+    v = StrictSerializabilityVerifier()
+    a = v.begin(10)
+    a.complete(11, {}, {})
+    b = v.begin(10)
+    b.complete(10, {k(1): ()}, {k(1): "x"})
+    c = v.begin(20)
+    c.complete(21, {k(1): ("x",)}, {})
+    v.verify()
+
+
+def test_verifier_self_pair_not_fractured():
+    # an op that writes two keys and reads both (not seeing its own writes)
+    # must not be flagged against itself by the pair index.
+    v = StrictSerializabilityVerifier()
+    a = v.begin(0)
+    a.complete(10, {k(1): (), k(2): ()}, {k(1): "x", k(2): "y"})
+    b = v.begin(20)
+    b.complete(30, {k(1): ("x",), k(2): ("y",)}, {})
+    v.verify()
+
+
+def test_verifier_scales_to_5k_ops():
+    # regression: the real-time and atomicity checks were O(n^2) pair scans;
+    # 5k sequential ops must verify in seconds, not minutes.
+    import random as _random
+    import time as _time
+    rng = _random.Random(7)
+    keys = [k(i) for i in range(8)]
+    v = StrictSerializabilityVerifier()
+    state = {key: [] for key in keys}
+    t = 0
+    for op in range(5000):
+        t += 1
+        obs = v.begin(t)
+        ks = rng.sample(keys, rng.randint(1, 3))
+        reads = {key: tuple(state[key]) for key in ks}
+        writes = {}
+        for key in ks:
+            if rng.random() < 0.5:
+                val = (op, key.value)
+                state[key].append(val)
+                writes[key] = val
+        t += 1
+        obs.complete(t, reads, writes)
+    t0 = _time.time()
+    v.verify({key: tuple(s) for key, s in state.items()})
+    assert _time.time() - t0 < 10.0
+
+
 # -- burn runs --------------------------------------------------------------
 
 @pytest.mark.parametrize("seed", [1, 2, 3])
