@@ -75,10 +75,11 @@ def fetch_data(node: "Node", txn_id: TxnId, route: Route) -> au.AsyncResult:
             target_route = merged.route if merged.route is not None else route
             merged.route = target_route
             # apply as a first-class LOCAL request (serializable, typed,
-            # replayable — Propagate.java), processed SYNCHRONOUSLY before
-            # the result settles: every fetch_data listener relies on the
-            # fetched knowledge being applied locally when it fires (a
-            # queued self-send would leave the progress log checking
+            # replayable — Propagate.java), and settle only when the
+            # per-store application chain settles: every fetch_data listener
+            # relies on the fetched knowledge being applied locally when it
+            # fires (with delayed stores the application defers — settling
+            # success immediately would leave the progress log checking
             # pre-propagation state and spuriously escalating to recovery).
             # Processed directly — NOT via node.receive, whose catch-all
             # would swallow an application failure and let the result settle
@@ -86,10 +87,15 @@ def fetch_data(node: "Node", txn_id: TxnId, route: Route) -> au.AsyncResult:
             from ..messages.base import LOCAL_NO_REPLY
             from ..messages.status_messages import Propagate
             try:
-                Propagate(txn_id, merged).process(node, node.id, LOCAL_NO_REPLY)
+                applied = Propagate(txn_id, merged).process(
+                    node, node.id, LOCAL_NO_REPLY)
             except BaseException as e:  # noqa: BLE001
                 result.set_failure(e)
                 return
+            applied.add_listener(
+                lambda _v, f: result.set_failure(f) if f is not None
+                else result.set_success(merged))
+            return
         result.set_success(merged)
 
     check_status_quorum(node, txn_id, route, include_info=True) \

@@ -375,23 +375,43 @@ class _Recover:
 
 def adopt_erased(node: "Node", txn_id: TxnId, route: Route) -> None:
     """A home-shard quorum member asserted ``txn_id`` sits below its durable
-    fence: the txn is settled (applied-then-erased, or can never commit).
-    Adopt the erased-tombstone state locally for any NOT-yet-decided copy so
-    waiters stop blocking on it (ErasedSafeCommand adoption; the truncate
-    notifies listeners).  Decided local copies are left alone — they resolve
-    through the normal apply path."""
+    fence: the txn is settled — but 'settled' means EITHER it can never commit
+    OR it applied at a quorum and was then erased.  A locally-undecided copy
+    cannot tell the two apart, and erasing it in the second case would drop
+    the dep as truncated and let waiters execute without the committed write's
+    data (the truncate() data-gap guard is gated on PRE_COMMITTED, so it never
+    fires here).  So: fetch_data FIRST — if a peer still carries the outcome,
+    Propagate applies it and the normal path (with its own gap-heal) takes
+    over.  Any copy STILL undecided after the fetch is erased with a
+    conservative stale-mark + peer-snapshot heal of its local write footprint,
+    because the outcome remains unknowable.  Decided local copies are left
+    alone — they resolve through the normal apply path."""
     from ..local import commands as C
     from ..local.durability import Cleanup
     from ..local.status import Status
 
-    def for_store(safe_store) -> None:
-        cmd = safe_store.get_if_exists(txn_id)
-        if cmd is None or cmd.save_status.is_truncated \
-                or cmd.has_been(Status.PRE_COMMITTED):
-            return
-        C.truncate(safe_store, cmd, Cleanup.ERASE)
+    def adopt(_merged=None, _failure=None) -> None:
+        # runs whether the fetch succeeded or not: on failure (quorum
+        # unreachable) waiters must still unblock, and the conservative heal
+        # below keeps reads redirected until the data plane is whole again
+        def for_store(safe_store) -> None:
+            cmd = safe_store.get_if_exists(txn_id)
+            if cmd is None or cmd.save_status.is_truncated \
+                    or cmd.has_been(Status.PRE_COMMITTED):
+                return
+            if txn_id.is_write:
+                cmd_route = cmd.route if cmd.route is not None else route
+                local_parts = cmd_route.participants().slice(
+                    safe_store.current_ranges())
+                if len(local_parts):
+                    from ..messages.status_messages import _heal_store_gaps
+                    _heal_store_gaps(node, safe_store, local_parts)
+            C.truncate(safe_store, cmd, Cleanup.ERASE)
 
-    node.for_each_local(route, txn_id.epoch, txn_id.epoch, for_store)
+        node.for_each_local(route, txn_id.epoch, txn_id.epoch, for_store)
+
+    from .fetch_data import fetch_data
+    fetch_data(node, txn_id, route).add_listener(adopt)
 
 
 def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,

@@ -231,13 +231,18 @@ class CheckStatus(TxnRequest):
 # local knowledge propagation (Propagate.java)
 # ---------------------------------------------------------------------------
 
-def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk) -> None:
+def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk):
     """Apply a merged knowledge view to the local stores, upgrading the local
     Known lattice: outcome -> apply; stable deps -> commit(STABLE); agreed
-    executeAt -> precommit; definition -> preaccept; invalidation propagates."""
+    executeAt -> precommit; definition -> preaccept; invalidation propagates.
+
+    Returns the AsyncResult of the per-store application chain — with delayed
+    stores the application defers, and callers (fetch_data) must not settle
+    success over un-applied knowledge."""
+    from ..utils import async_ as au
     route = merged.route
     if route is None:
-        return
+        return au.success_result(None)
     max_epoch = merged.execute_at.epoch if merged.execute_at is not None else txn_id.epoch
 
     def for_store(safe_store: SafeCommandStore) -> None:
@@ -302,7 +307,7 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk) -> N
         if status.has_been(Status.PRE_ACCEPTED) and merged.partial_txn is not None:
             C.preaccept(safe_store, txn_id, merged.partial_txn, route)
 
-    node.for_each_local(route, txn_id.epoch, max_epoch, for_store)
+    return node.for_each_local(route, txn_id.epoch, max_epoch, for_store)
 
 
 def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
@@ -318,24 +323,50 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
     if not len(rngs):
         return
     store = node.data_store
-    topology = node.config_service.current_topology()
-    # PER-SHARD fetch plan: the stale mark may only clear when EVERY shard
-    # slice of the footprint was healed by a replica of THAT shard (one Ok
-    # from a different shard's peer says nothing about this slice)
-    plan = []
-    for shard in topology.shards:
-        sub = rngs.intersection(_Rs.of(shard.range))
-        if len(sub):
-            peers = sorted(n for n in shard.nodes if n != node.id)
-            if peers:
-                plan.append((sub, peers))
-    if not plan:
+
+    def current_plan(open_rngs):
+        """PER-SHARD fetch plan against the CURRENT topology: the stale mark
+        may only clear when EVERY shard slice of the footprint was healed by a
+        replica of THAT shard (one Ok from a different shard's peer says
+        nothing about this slice).  Recomputed each retry round — replicas
+        replaced under topology churn must not leave the heal retrying a
+        stale peer list forever."""
+        topology = node.config_service.current_topology()
+        plan = []
+        for shard in topology.shards:
+            sub = open_rngs.intersection(_Rs.of(shard.range))
+            if len(sub):
+                peers = sorted(n for n in shard.nodes if n != node.id)
+                if peers:
+                    plan.append((sub, peers))
+        return plan
+
+    if not current_plan(rngs):
         return   # no peer can heal (lone replica): marking stale would
                  # permanently refuse reads with nothing to redirect to
     token = store.mark_stale(rngs)   # reads redirect until the gap heals
+    state = {"open": rngs}
 
-    def attempt(remaining) -> None:
-        state = {"open": list(remaining)}
+    def attempt(delay: float) -> None:
+        """One heal round over the still-open footprint; unhealed remainder
+        retries with capped backoff — partitions re-roll and churn replaces
+        replicas, so availability returns without re-exposing the hole."""
+        next_delay = min(delay * 2, 16.0)
+        plan = current_plan(state["open"])
+        if not plan:
+            node.scheduler.once(delay, lambda: attempt(next_delay))
+            return
+        round_ = {"pending": len(plan)}
+
+        def slice_done(sub, healed: bool) -> None:
+            if healed:
+                state["open"] = state["open"].without(sub)
+            round_["pending"] -= 1
+            if round_["pending"] == 0:
+                if not len(state["open"]):
+                    store.clear_stale(token)
+                else:
+                    node.scheduler.once(delay, lambda: attempt(next_delay))
 
         def slice_attempt(sub, peers) -> None:
             st = {"pending": len(peers), "healed": False}
@@ -349,36 +380,21 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
                             for ts, value in entries:
                                 store.append(key, ts, value)
                     if st["pending"] == 0:
-                        done()
+                        slice_done(sub, st["healed"])
 
                 def on_failure(self, from_node: int, failure: BaseException) -> None:
                     st["pending"] -= 1
                     if st["pending"] == 0:
-                        done()
-
-            def done() -> None:
-                """Shared epilogue — not dependent on WHICH reply was last."""
-                if st["healed"]:
-                    state["open"] = [(s, p) for s, p in state["open"]
-                                     if s is not sub]
-                if not state["open"]:
-                    store.clear_stale(token)
-                if not st["healed"]:
-                    # every peer of this shard failed (chaos) or refused
-                    # (their own gaps): keep trying at a low cadence —
-                    # partitions re-roll, so availability returns without
-                    # ever re-exposing the hole
-                    node.scheduler.once(2.0,
-                                        lambda: slice_attempt(sub, peers))
+                        slice_done(sub, st["healed"])
 
             callback = HealCallback()
             for to in peers:
                 node.send(to, FetchStoreData(sub), callback)
 
-        for sub, peers in state["open"]:
+        for sub, peers in plan:
             slice_attempt(sub, peers)
 
-    attempt(plan)
+    attempt(2.0)
 
 
 # ---------------------------------------------------------------------------
@@ -508,8 +524,10 @@ class Propagate(Request):
             return MessageType.PROPAGATE_PRE_ACCEPT_MSG
         return MessageType.PROPAGATE_OTHER_MSG
 
-    def process(self, node: "Node", from_node: int, reply_context) -> None:
-        propagate_knowledge(node, self.txn_id, self.merged)
+    def process(self, node: "Node", from_node: int, reply_context):
+        """Returns the propagation AsyncResult so a direct caller (fetch_data)
+        can settle on actual application; the normal receive path ignores it."""
+        return propagate_knowledge(node, self.txn_id, self.merged)
 
     def __repr__(self):
         return f"Propagate({self.txn_id!r}, {self.merged.save_status.name})"
