@@ -45,6 +45,18 @@ class ProgressToken(NamedTuple):
                 or self.status_ordinal > prev.status_ordinal
                 or self.promised > prev.promised)
 
+    def advanced_materially_from(self, prev: Optional["ProgressToken"]) -> bool:
+        """Durability/status advance only.  A promised ballot rising with no
+        status movement is the signature of FAILED recovery attempts (mutual
+        preemption), not of progress — monitors treating it as progress reset
+        their backoff and keep the attempt rate high forever (the hostile
+        chaos+churn burns livelocked on exactly this: ballots ratcheted for
+        hundreds of sim-seconds with every replica READY_TO_EXECUTE)."""
+        if prev is None:
+            return True
+        return (self.durability > prev.durability
+                or self.status_ordinal > prev.status_ordinal)
+
     @property
     def is_done(self) -> bool:
         return self.status_ordinal >= SaveStatus.APPLIED.ordinal

@@ -199,13 +199,18 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         evict_rng = rng.fork()
 
         def evict_some():
-            from ..local.command_store import SafeCommandStore
+            # eviction runs INSIDE each store's executor: a deferred store
+            # task (delayed_stores) may still hold a direct reference to a
+            # command — evicting from outside would let a later lookup fault
+            # in a SECOND live instance of the same command, silently breaking
+            # the single-instance invariant even when no mutation races
             for node in cluster.nodes.values():
                 for cs in node.command_stores.all_stores():
-                    safe = SafeCommandStore(cs)
-                    for tid in list(cs.commands):
-                        if evict_rng.next_float() < 0.3:
-                            safe.evict(tid)
+                    def evict_in_store(safe, cs=cs):
+                        for tid in list(cs.commands):
+                            if evict_rng.next_float() < 0.3:
+                                safe.evict(tid)
+                    cs.execute(evict_in_store)
         cache_miss_task = cluster.scheduler.recurring(0.4, evict_some)
 
     frontier_task = None
@@ -456,6 +461,10 @@ def main(argv=None) -> None:
                    choices=[None, "cpu", "tpu", "verify"])
     p.add_argument("--benign", action="store_true",
                    help="disable the chaos network")
+    p.add_argument("--no-churn", action="store_true",
+                   help="disable topology churn (churn is part of the "
+                        "default hostile matrix: the reference's hardest "
+                        "regime mutates topology DURING partitions)")
     p.add_argument("--no-cache-miss", action="store_true")
     p.add_argument("--reconcile", action="store_true",
                    help="double-run each seed and diff full traces")
@@ -467,6 +476,7 @@ def main(argv=None) -> None:
         kw = dict(ops=args.ops, concurrency=args.concurrency, rf=rf,
                   nodes=args.nodes, resolver=args.resolver,
                   chaos=not args.benign, allow_failures=not args.benign,
+                  topology_churn=not args.no_churn,
                   durability=True, journal=True,
                   delayed_stores=not args.benign, clock_drift=not args.benign,
                   cache_miss=not args.no_cache_miss,

@@ -20,6 +20,7 @@ from ..primitives.timestamp import TxnId
 
 _FIELDS = ("save_status", "durability", "route", "partial_txn", "partial_deps",
            "promised", "accepted_or_committed", "execute_at", "writes", "result")
+_MISSING = object()
 
 
 def _encode_fields(command: Command) -> Dict[str, object]:
@@ -34,6 +35,15 @@ class Journal:
         self.logs: Dict[Tuple[int, int], Dict[TxnId, List[Dict[str, object]]]] = {}
         # last full encoded state per txn (for diffing)
         self._last: Dict[Tuple[int, int, TxnId], Dict[str, object]] = {}
+        # decoded-route memo for peek_route (invalidated on save/erase)
+        self._routes: Dict[Tuple[int, int, TxnId], object] = {}
+        # last raw field objects per txn: a field whose object is IDENTICAL
+        # (is) to the last-saved one cannot have changed (command fields are
+        # assigned, never mutated in place) and skips re-encoding — without
+        # this every transition re-encodes the full deps payload just to
+        # discover it is unchanged (dominant cost in hostile burns);
+        # verify_against still proves the recorded state sufficient
+        self._raw: Dict[Tuple[int, int, TxnId], Dict[str, object]] = {}
         self.records = 0
 
     def attach(self, store) -> None:
@@ -42,17 +52,30 @@ class Journal:
 
     # -- recording -----------------------------------------------------------
     def save(self, store, command: Command) -> None:
-        key = (store.node.id, store.id)
-        full = _encode_fields(command)
-        prev = self._last.get(key + (command.txn_id,))
+        key3 = (store.node.id, store.id, command.txn_id)
+        prev = self._last.get(key3)
         if prev is None:
-            diff = full
+            diff = _encode_fields(command)
+            self._last[key3] = dict(diff)
+            self._raw[key3] = {f: getattr(command, f) for f in _FIELDS}
         else:
-            diff = {f: v for f, v in full.items() if prev.get(f) != v}
+            raw = self._raw.setdefault(key3, {})
+            diff = {}
+            for f in _FIELDS:
+                v = getattr(command, f)
+                if raw.get(f, _MISSING) is v:
+                    continue
+                raw[f] = v
+                enc = codec.encode_value(v)
+                if prev.get(f) != enc:
+                    prev[f] = enc
+                    diff[f] = enc
             if not diff:
                 return
-        self._last[key + (command.txn_id,)] = full
-        self.logs.setdefault(key, {}).setdefault(command.txn_id, []).append(diff)
+        if "route" in diff:
+            self._routes.pop(key3, None)
+        self.logs.setdefault(key3[:2], {}).setdefault(command.txn_id, []) \
+            .append(diff)
         self.records += 1
 
     def erase(self, store, txn_id: TxnId) -> None:
@@ -60,6 +83,33 @@ class Journal:
         key = (store.node.id, store.id)
         self.logs.get(key, {}).pop(txn_id, None)
         self._last.pop(key + (txn_id,), None)
+        self._routes.pop(key + (txn_id,), None)
+        self._raw.pop(key + (txn_id,), None)
+
+    def on_evict(self, store, txn_id: TxnId) -> None:
+        """The store evicted this command: drop the raw-identity memo so the
+        journal does not pin the full field object graph of cold state (the
+        encoded _last stays — it IS the fault-in source).  The next save after
+        a fault-in re-encodes each field once and repopulates the memo."""
+        self._raw.pop((store.node.id, store.id, txn_id), None)
+
+    def peek_route(self, store, txn_id: TxnId):
+        """Decode ONLY the journaled route of an evicted command — scans that
+        merely need a footprint filter (recovery evidence) must not pay a full
+        command decode per cold entry (the hostile churn matrix spent most of
+        its wall-clock in exactly that)."""
+        key3 = (store.node.id, store.id, txn_id)
+        route = self._routes.get(key3)
+        if route is None:
+            full = self._last.get(key3)
+            if full is None:
+                return None
+            enc = full.get("route")
+            if enc is None:
+                return None
+            route = codec.decode_value(enc)
+            self._routes[key3] = route
+        return route
 
     # -- reconstruction (Journal.reconstruct) --------------------------------
     def reconstruct(self, node_id: int, store_id: int) -> Dict[TxnId, Command]:

@@ -59,7 +59,10 @@ class _MonitorState:
         self.cooldown = 0
 
     def investigation_failed(self) -> None:
-        self.backoff = min(self.backoff * 2 + 1, 8)
+        # cap high enough that, across several contending monitors, one
+        # eventually gets a window longer than a full recovery pipeline
+        # (reads over delayed stores can take seconds of sim time)
+        self.backoff = min(self.backoff * 2 + 1, 32)
         self.cooldown = self.backoff
         self.progress = Progress.NO_PROGRESS
 
@@ -252,7 +255,10 @@ class SimpleProgressLog(ProgressLog):
             if outcome.settled:
                 self._done(state.txn_id)
             elif current is not None:
-                if outcome.token.advanced_from(current.token):
+                # ballot-only movement is NOT progress: it means competing
+                # recovery attempts are preempting each other — back off so
+                # one of them eventually runs uncontended to completion
+                if outcome.token.advanced_materially_from(current.token):
                     current.investigation_progressed()
                 else:
                     current.investigation_failed()
@@ -290,9 +296,15 @@ class SimpleProgressLog(ProgressLog):
                 return
             token = ProgressToken.of(merged) if merged is not None else None
             if token is not None and token.advanced_from(current.token):
-                current.investigation_progressed()
+                # real (status/durability) advance: reset the backoff; a
+                # ballot-only advance stands down (a competing attempt is in
+                # flight — preempting it helps nobody) but GROWS the backoff
+                if token.advanced_materially_from(current.token):
+                    current.investigation_progressed()
+                    current.progress = Progress.NO_PROGRESS  # escalate next poll if stalled
+                else:
+                    current.investigation_failed()
                 current.token = token
-                current.progress = Progress.NO_PROGRESS  # escalate next poll if stalled
                 return
 
             # stalled and undecided: settle it

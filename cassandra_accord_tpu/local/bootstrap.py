@@ -32,6 +32,16 @@ class Bootstrap:
         self.ranges = ranges
         self.epoch = epoch
         self.result = au.settable()
+        self.attempts = 0
+
+    def _retry_delay(self) -> float:
+        """Exponential backoff for the attempt ladder (Bootstrap.Attempt).
+        Under chaos+churn a flat cadence floods stores with abandoned fence
+        sync points (each attempt allocates a fresh ExclusiveSyncPoint txn
+        that then needs recovery/invalidation) — the hostile matrix went
+        superlinear on exactly this."""
+        self.attempts += 1
+        return min(0.5 * (2.0 ** (self.attempts - 1)), 8.0)
 
     def start(self) -> au.AsyncResult:
         self.store.pending_bootstrap = self.store.pending_bootstrap.union(self.ranges)
@@ -64,7 +74,7 @@ class Bootstrap:
         if failure is not None:
             # retry ladder (Bootstrap.Attempt): the agent decides; default retries
             def retry():
-                self.node.scheduler.once(0.5, self._attempt)
+                self.node.scheduler.once(self._retry_delay(), self._attempt)
             self.node.agent.on_failed_bootstrap("sync point", self.ranges, retry,
                                                 failure)
             return
@@ -93,7 +103,8 @@ class Bootstrap:
         if failure is not None:
             def retry():
                 self.node.scheduler.once(
-                    0.5, lambda: self._on_sync_point(sync_point, None))
+                    self._retry_delay(),
+                    lambda: self._on_sync_point(sync_point, None))
             self.node.agent.on_failed_bootstrap("fetch", self.ranges, retry, failure)
             return
 
@@ -111,18 +122,41 @@ class Bootstrap:
 
 def _reevaluate_waiting(safe_store) -> None:
     """Drop now-redundant (pre-bootstrap) deps from every waiting command and
-    try to execute it (Commands re-evaluation after bootstrappedAt advances)."""
+    try to execute it (Commands re-evaluation after bootstrappedAt advances).
+
+    Runs on every bootstrap mark/finish — including each rung of the retry
+    ladder — so the scan is gated by the store-wide MAX locally-redundant
+    bound: is_locally_redundant requires the dep below the bound at EVERY
+    footprint point, so any dep at/above the max bound anywhere is
+    unprunable and skipped with one comparison instead of an interval-map
+    sweep (the hostile churn matrix spent >30% of its time in the sweeps)."""
     from . import commands as C
     store = safe_store.store
     redundant = store.redundant_before
+    max_bound = redundant.max_locally_redundant_over(store.all_ranges())
+    if max_bound is None:
+        return
+    # hot conflicts repeat across waiters with identical per-store dep slices:
+    # memoise the redundancy verdict per (dep, footprint) so each distinct
+    # sweep runs once per re-evaluation instead of once per waiting edge
+    memo: dict = {}
     for command in list(store.commands.values()):
         waiting = command.waiting_on
         if waiting is None or not waiting.is_waiting():
             continue
         deps = command.partial_deps
         for dep_id in list(waiting.waiting):
+            if not dep_id < max_bound:
+                continue
             parts = deps.participants(dep_id) if deps is not None else None
-            if parts is not None and redundant.is_locally_redundant(dep_id, parts):
+            if parts is None:
+                continue
+            keys, rngs = parts
+            mk = (dep_id, tuple(keys), tuple((r.start, r.end) for r in rngs))
+            hit = memo.get(mk)
+            if hit is None:
+                hit = memo[mk] = redundant.is_locally_redundant(dep_id, parts)
+            if hit:
                 waiting.remove(dep_id, True)
                 store.resolver.remove_waiting(command.txn_id, dep_id)
                 dep = safe_store.get_if_exists(dep_id)

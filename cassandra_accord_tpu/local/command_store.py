@@ -83,6 +83,9 @@ class CommandStore:
         # cache-miss injection): ids whose command state was EVICTED from
         # memory and lives only in the journal; faulted back in on access
         self.cold: set = set()
+        # cold-GC memo: cold id -> the (redundant, majority, universal, shard)
+        # max bounds it was last evaluated under; re-fault only on advance
+        self.cold_gc_seen: dict = {}
         self.cache_miss_loads = 0
         # the conflict-index data plane (impl/resolver.py): answers the deps
         # and max-conflict queries; cpu = cfk walk, tpu = device GraphState
@@ -202,6 +205,7 @@ class SafeCommandStore:
             return False
         del store.commands[txn_id]
         store.cold.add(txn_id)
+        store.journal.on_evict(store, txn_id)
         return True
 
     # -- cfk ----------------------------------------------------------------
@@ -421,14 +425,32 @@ class SafeCommandStore:
         # evicted commands are still subject to GC — but only ids below the
         # highest locally-redundant bound can possibly be cleanable
         # (should_cleanup gates on is_locally_redundant), so only those fault
-        # in; the rest stay cold (faulting the whole set every round would
-        # defeat the eviction and re-heat the cache for nothing)
-        gc_bound = store.redundant_before.max_locally_redundant_over(
-            store.all_ranges())
+        # in; the rest stay cold.  A cold id is re-evaluated only when a bound
+        # that could RAISE its cleanup tier has advanced since it was last
+        # evaluated (cold_gc_seen memo): run_gc fires on every durability
+        # message, and unconditionally re-faulting the whole cold set decoded
+        # every journal entry each time — the hostile churn matrix spent most
+        # of its wall-clock in exactly that codec thrash.
+        footprint = store.all_ranges()
+        gc_bound = store.redundant_before.max_locally_redundant_over(footprint)
         if gc_bound is not None:
+            maj, uni = store.durable_before.max_bounds_over(footprint)
+            shard = store.redundant_before.max_shard_redundant_over(footprint)
+            sig = (gc_bound, maj, uni, shard)
+            seen = store.cold_gc_seen
+            # the store-wide maxes can miss a PER-RANGE bound advance (another
+            # range's entries dominate every max): clear the memo on a slow
+            # cadence so such cold commands are still eventually re-evaluated
+            store.gc_runs = getattr(store, "gc_runs", 0) + 1
+            if store.gc_runs % 32 == 0:
+                seen = store.cold_gc_seen = {}
             for cold_id in list(store.cold):
-                if cold_id < gc_bound:
+                if cold_id < gc_bound and seen.get(cold_id) != sig:
+                    seen[cold_id] = sig
                     self.get_if_exists(cold_id)
+            if len(seen) > 2 * len(store.cold):
+                store.cold_gc_seen = {
+                    k: v for k, v in seen.items() if k in store.cold}
         for txn_id, cmd in list(store.commands.items()):
             cleanup = should_cleanup(cmd, store.redundant_before, store.durable_before)
             if cleanup is Cleanup.NO:

@@ -426,6 +426,11 @@ def _maybe_defer_execute_at_least(safe_store: SafeCommandStore, waiter: Command,
 
 def _still_blocks(safe_store: SafeCommandStore, command: Command, dep_id: TxnId,
                   execute_at: Timestamp) -> bool:
+    if dep_id in safe_store.store.cold:
+        # eviction admits only terminal commands (applied/invalidated/
+        # truncated), none of which block — answering from the cold set
+        # avoids a full journal decode per dep on every WaitingOn build
+        return False
     dep = safe_store.get_if_exists(dep_id)
     if dep is None:
         return True  # unwitnessed: must wait for it to commit locally

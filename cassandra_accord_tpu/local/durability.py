@@ -19,7 +19,7 @@ All are piecewise-constant maps over the routing-key space
 from __future__ import annotations
 
 import enum
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Tuple
 
 from ..primitives.keys import Range, Ranges, RoutingKey
 from ..primitives.timestamp import Timestamp, TxnId
@@ -211,6 +211,16 @@ class RedundantBefore:
                 out = _min_ts(out, b)
         return out
 
+    def max_shard_redundant_over(self, participants) -> Optional[TxnId]:
+        """Highest shard-applied bound anywhere on the footprint (necessary-
+        condition filter for is_shard_redundant, like
+        max_locally_redundant_over)."""
+        out = None
+        for e in _entries_over(self.map, participants):
+            if e is not None:
+                out = _max_ts(out, e.shard_applied_before)
+        return out
+
     def pre_bootstrap_or_stale(self, txn_id: TxnId, participants) -> PreBootstrapOrStale:
         """Is ``txn_id`` before a bootstrap (or staleness) bound on all / some /
         none of its footprint?"""
@@ -289,6 +299,17 @@ class DurableBefore:
         if e.majority_before is not None and txn_id < e.majority_before:
             return Durability.MAJORITY
         return Durability.NOT_DURABLE
+
+    def max_bounds_over(self, participants) -> Tuple[Optional[TxnId], Optional[TxnId]]:
+        """(max majority, max universal) bound anywhere on the footprint —
+        necessary-condition filters: no txn at/above the max can reach the
+        corresponding cleanup tier (min_durability requires it everywhere)."""
+        maj = uni = None
+        for e in _entries_over(self.map, participants):
+            if e is not None:
+                maj = _max_ts(maj, e.majority_before)
+                uni = _max_ts(uni, e.universal_before)
+        return maj, uni
 
     def min_durability(self, txn_id: TxnId, participants) -> Durability:
         entries = list(_entries_over(self.map, participants))

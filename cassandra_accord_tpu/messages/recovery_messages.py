@@ -189,8 +189,20 @@ def _scan_conflicting(safe_store: SafeCommandStore, txn_id: TxnId, keys):
     whose kind would witness ours (the mapReduceFull scan; the reference indexes
     this via cfk, we scan the command map — recovery is rare)."""
     # fault evicted commands back in: the evidence scan must see EVERY
-    # conflicting txn, memory-resident or not (cache-miss plane)
-    for cold_id in list(safe_store.store.cold):
+    # conflicting txn, memory-resident or not (cache-miss plane).  The
+    # journaled ROUTE is peeked first — only commands whose footprint can
+    # intersect pay the full command decode (route.participants() is a
+    # superset of the txn-keys footprint, so the filter is conservative)
+    store = safe_store.store
+    journal = store.journal
+    for cold_id in list(store.cold):
+        if cold_id == txn_id or not txn_id.witnessed_by(cold_id.kind):
+            continue
+        if journal is not None:
+            route = journal.peek_route(store, cold_id)
+            if route is not None \
+                    and not _intersects(keys, route.participants()):
+                continue
         safe_store.get_if_exists(cold_id)
     for other_id, command in safe_store.store.commands.items():
         if other_id == txn_id or not txn_id.witnessed_by(other_id.kind):
