@@ -253,6 +253,25 @@ class _ExecuteTxn:
         self.stable_tracker = QuorumTracker(topologies)
         self.data = None
         self.done = False
+        # partial-coverage accounting: per shard, the footprint slice still
+        # unread.  A replica mid-bootstrap serves its clean slice and reports
+        # the pending remainder unavailable (ReadOk.unavailable); coverage
+        # completes when the UNION of replies covers each shard — no single
+        # replica needs to serve the whole slice (ReadCoordinator capability;
+        # without it, wide range reads deadlocked against bootstrap fences
+        # under topology churn)
+        self._unread = {}
+        parts = route.participants()
+        from ..primitives.keys import Ranges as _Rs
+        for i, t in enumerate(self.read_tracker.trackers):
+            if isinstance(parts, _Rs):
+                sl = parts.intersection(_Rs.of(t.shard.range))
+                if len(sl):
+                    self._unread[i] = sl
+            else:
+                ks = {k for k in parts if t.shard.range.contains(k)}
+                if ks:
+                    self._unread[i] = ks
 
     @property
     def needs_read(self) -> bool:
@@ -275,6 +294,23 @@ class _ExecuteTxn:
                     if reply.data is not None:
                         this.data = reply.data if this.data is None else this.data.merge(reply.data)
                     this.on_stable_ack(from_node)
+                    if reply.unavailable is not None and len(reply.unavailable):
+                        # partial read: absorb the served slice; the shard
+                        # completes when the union of replies covers it
+                        if this.absorb_partial(from_node, reply.unavailable):
+                            if this.read_tracker.record_read_success(from_node) \
+                                    is RequestStatus.SUCCESS:
+                                this.maybe_finish()
+                            return
+                        status, retries = this.read_tracker.record_read_failure(from_node)
+                        if status is RequestStatus.FAILED:
+                            this.done = True
+                            this.result.set_failure(Exhausted(this.txn_id, "read"))
+                            return
+                        for to in retries:
+                            this.send_read_retry(to)
+                        return
+                    this.absorb_partial(from_node, None)
                     if not this.done and this.read_tracker.record_read_success(from_node) \
                             is RequestStatus.SUCCESS:
                         this.maybe_finish()
@@ -346,6 +382,35 @@ class _ExecuteTxn:
 
     def on_stable_ack(self, from_node: int) -> None:
         self.stable_tracker.record_success(from_node)
+
+    def absorb_partial(self, from_node: int, unavailable) -> bool:
+        """Fold one read reply's coverage into the per-shard unread residue:
+        remaining = remaining ∩ unavailable (what this replica could NOT
+        serve).  Returns True iff every shard this node was reading for is
+        now fully covered by the union of replies so far."""
+        all_covered = True
+        for i, t in enumerate(self.read_tracker.trackers):
+            if from_node not in t.in_flight_reads:
+                continue
+            cur = self._unread.get(i)
+            if cur is None:
+                continue
+            if unavailable is None or not len(unavailable):
+                cur = type(cur)() if isinstance(cur, set) else cur.without(cur)
+            elif isinstance(cur, set):
+                cur = {k for k in cur if unavailable.contains(k)}
+            else:
+                cur = cur.intersection(unavailable)
+            self._unread[i] = cur
+            if cur and len(cur):
+                all_covered = False
+            else:
+                # the union of replies covers this shard: it is READ — the
+                # tracker must not burn further candidates on it (exhausting
+                # them reported spurious read failure while coverage was
+                # already complete)
+                t.data_received = True
+        return all_covered
 
     def maybe_finish(self) -> None:
         if self.done:

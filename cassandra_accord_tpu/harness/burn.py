@@ -126,7 +126,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              batch_window_us: int = 0,
              cache_miss: bool = False,
              max_tasks: int = 20_000_000,
-             tracer=None, on_submit=None) -> BurnResult:
+             tracer=None, on_submit=None, consult_recorder=None) -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation.
 
     ``chaos=True`` turns on the hostile network (randomized drops, failures,
@@ -163,6 +163,12 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                       progress_poll_s=progress_poll_s,
                       batch_window_us=batch_window_us)
     cluster.tracer = tracer
+    if consult_recorder is not None:
+        # trace-driven data-plane bench (harness/consult_trace.py): wrap every
+        # store's resolver so the full mutation+query stream is captured
+        for node in cluster.nodes.values():
+            for cs in node.command_stores.all_stores():
+                consult_recorder.wrap_store(cs)
     # debugging handle (stall forensics): weak, so finished runs don't pin the
     # whole cluster graph in a module global
     import weakref

@@ -356,6 +356,10 @@ class Cluster:
         # where event is the link action taken or "REPLY"/"REPLY_<action>"
         # (the reference's accord.impl.basic.Trace logger, Cluster.java:237-264)
         self.tracer: Optional[Callable] = None
+        # controllable-delivery hook (MockCluster/Network capability,
+        # impl/mock/MockCluster.java): fn(from, to, request, msg_id,
+        # has_callback) -> True to swallow (the hook owns delivery/reply)
+        self.request_filter: Optional[Callable] = None
         self.link = link_config or LinkConfig(self.rng.fork())
         self.reply_timeout_s = reply_timeout_s
         # request-delivery coalescing: requests arriving at a node within
@@ -445,6 +449,10 @@ class Cluster:
     def route(self, from_node: int, to_node: int, request: Request, msg_id: int,
               has_callback: bool) -> None:
         self._count(f"{type(request).__name__}")
+        if self.request_filter is not None and \
+                self.request_filter(from_node, to_node, request, msg_id,
+                                    has_callback):
+            return
         action = self.link.action(from_node, to_node, request) if from_node != to_node \
             else LinkConfig.DELIVER
         if self.tracer is not None:

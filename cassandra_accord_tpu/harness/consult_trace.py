@@ -1,0 +1,519 @@
+"""Consult-stream recording + scaled replay: the trace-driven data-plane bench.
+
+The honest end-to-end protocol bench is Amdahl-capped by the Python control
+plane, and at burn-scale index sizes the resolver cost model correctly keeps
+every consult on the walk/host tiers — so the device tier never serves live
+protocol traffic there (BENCH_r03 `resolver_device_consults: 0`).  This module
+closes that gap with PROTOCOL-SEMANTICS traffic at data-plane scale:
+
+1. **Record** — ``ConsultRecorder`` wraps every store's ``DepsResolver``
+   during a real contended burn and captures the COMPLETE stream the protocol
+   drove through it: registrations (witness/upgrade), prunes, durability-gate
+   advances, delivery-window prefetches, and every query with its exact
+   arguments.  This is the workload of ``SafeCommandStore.mapReduceActive`` /
+   ``MaxConflicts`` (SafeCommandStore.java:292, cfk/CommandsForKey.java:925)
+   as the protocol actually issued it — not a synthetic array shape.
+
+2. **Replay at scale** — ``replay_stream`` re-drives N identity-rebased
+   copies of that stream, interleaved event-by-event, into ONE fresh resolver
+   (T multiplies by N: the store of a node serving N× the key universe at the
+   recorded per-key contention).  Each copy's txn ids are hlc-offset and its
+   keys value-offset, so copies stay disjoint and every per-copy answer keeps
+   the recorded protocol semantics (elision gates, window coalescing,
+   sequential exactness) — while the index grows to the regime the MXU join
+   was built for (BASELINE configs 3-5).
+
+3. **Tier comparison** — the same stream replays under each execution tier
+   (``walk`` = the scalar cfk oracle, ``host`` = vectorized numpy,
+   ``device`` = the fused MXU consult, ``auto`` = the production cost model),
+   yielding queries/s and commits-equivalent/s (total commits the recorded
+   protocol achieved per consult workload, scaled by copies).  A sampled
+   parity check asserts the tiers agree answer-for-answer.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..impl.resolver import DepsResolver, QuerySpec
+from ..primitives.keys import IntKey, Range, RoutingKey
+from ..primitives.timestamp import Domain, Timestamp, TxnId
+from ..utils.invariants import check_state
+
+
+class ConsultRecorder:
+    """Captures one store's resolver stream (attach via ``wrap_store``)."""
+
+    def __init__(self):
+        self.streams: Dict[object, List[tuple]] = {}
+        self.peak_live: Dict[object, int] = {}
+        self.commits: Dict[object, int] = {}
+
+    def wrap_store(self, store) -> None:
+        store.resolver = _RecordingResolver(store.resolver, self, store)
+
+    def unit_stream(self) -> List[tuple]:
+        """The largest recorded per-store stream (the replay unit)."""
+        check_state(bool(self.streams), "nothing recorded")
+        key = max(self.streams, key=lambda k: len(self.streams[k]))
+        return self.streams[key]
+
+    def unit_peak_live(self) -> int:
+        key = max(self.streams, key=lambda k: len(self.streams[k]))
+        return max(1, self.peak_live.get(key, 1))
+
+    def unit_commits(self) -> int:
+        key = max(self.streams, key=lambda k: len(self.streams[k]))
+        return self.commits.get(key, 0)
+
+
+class _RecordingResolver(DepsResolver):
+    """Delegating wrapper: records the full mutation+query stream, plus the
+    store's durability-gate state whenever its generation advances (the
+    elision soundness gate is part of the query semantics)."""
+
+    def __init__(self, inner: DepsResolver, rec: ConsultRecorder, store):
+        self.inner = inner
+        self.rec = rec
+        self.store = store
+        self.events = rec.streams.setdefault(store, [])
+        self._gen_seen = -1
+        self._live = 0
+        self._committed_seen = set()
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def _probe_durable(self) -> None:
+        gen = getattr(self.store, "durable_gen", None)
+        if gen is None or gen == self._gen_seen:
+            return
+        self._gen_seen = gen
+        db = self.store.durable_before
+        snap = {}
+        for rk in self.store.cfks:
+            e = db.entry(rk)
+            if e is not None and e.majority_before is not None:
+                snap[rk] = e.majority_before
+        self.events.append(("durable", snap))
+
+    # -- mutations -----------------------------------------------------------
+    def register(self, txn_id, status, execute_at, keys) -> None:
+        self._probe_durable()
+        from ..local.cfk import InternalStatus as IS
+        self.events.append(("reg", txn_id, int(status), execute_at, tuple(keys)))
+        if int(status) >= int(IS.COMMITTED) and txn_id not in self._committed_seen:
+            self._committed_seen.add(txn_id)
+            self.rec.commits[self.store] = self.rec.commits.get(self.store, 0) + 1
+        self.inner.register(txn_id, status, execute_at, keys)
+        live = getattr(self.inner, "indexed_count", lambda: None)()
+        if live is None:
+            self._live += 1
+            live = self._live
+        self.rec.peak_live[self.store] = max(
+            self.rec.peak_live.get(self.store, 0), live)
+
+    def on_pruned(self, key, txn_ids) -> None:
+        self._probe_durable()
+        ids = tuple(txn_ids)
+        if ids:
+            self.events.append(("prune", key, ids))
+        self.inner.on_pruned(key, ids)
+
+    # -- batching ------------------------------------------------------------
+    def prefetch(self, specs) -> None:
+        self._probe_durable()
+        self.events.append(("prefetch", tuple(
+            (s.op, s.by, tuple(s.keys), s.before) for s in specs)))
+        self.inner.prefetch(specs)
+
+    def end_batch(self) -> None:
+        self.events.append(("end",))
+        self.inner.end_batch()
+
+    # -- frontier mirror (not replayed; passthrough) --------------------------
+    def register_waiting(self, waiter, deps) -> None:
+        self.inner.register_waiting(waiter, deps)
+
+    def remove_waiting(self, waiter, dep) -> None:
+        self.inner.remove_waiting(waiter, dep)
+
+    # -- queries -------------------------------------------------------------
+    def key_conflicts(self, by, keys, before):
+        self._probe_durable()
+        self.events.append(("kc", by, tuple(keys), before))
+        return self.inner.key_conflicts(by, keys, before)
+
+    def range_conflicts(self, by, rng, before):
+        self._probe_durable()
+        self.events.append(("rc", by, rng, before))
+        return self.inner.range_conflicts(by, rng, before)
+
+    def max_conflict_keys(self, keys):
+        self._probe_durable()
+        self.events.append(("mc", tuple(keys)))
+        return self.inner.max_conflict_keys(keys)
+
+    def max_conflict_range(self, rng):
+        self._probe_durable()
+        self.events.append(("mcr", rng))
+        return self.inner.max_conflict_range(rng)
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+class _ReplayEntry:
+    __slots__ = ("majority_before",)
+
+    def __init__(self, bound):
+        self.majority_before = bound
+
+
+class _ReplayDurable:
+    __slots__ = ("by_key",)
+
+    def __init__(self):
+        self.by_key: Dict[RoutingKey, object] = {}
+
+    def entry(self, rk):
+        b = self.by_key.get(rk)
+        return None if b is None else _ReplayEntry(b)
+
+
+class ReplayStore:
+    """Minimal CommandStore stand-in: exactly the surface the resolvers read
+    (cfk mirrors for the walk oracle, the durability gate, and nothing else)."""
+
+    def __init__(self):
+        self.cfks: Dict[RoutingKey, object] = {}
+        self.durable_before = _ReplayDurable()
+        self.durable_gen = 0
+
+    def cfk(self, rk):
+        from ..local.cfk import CommandsForKey
+        c = self.cfks.get(rk)
+        if c is None:
+            c = self.cfks[rk] = CommandsForKey(rk)
+        return c
+
+
+class _Rebase:
+    """Identity rebasing for one stream copy: txn ids shift by an hlc offset,
+    IntKeys by a value stride — copies are disjoint in both spaces while every
+    intra-copy order relation is preserved."""
+
+    def __init__(self, copy: int, hlc_stride: int, key_stride: int):
+        self.hlc_off = copy * hlc_stride
+        self.key_off = copy * key_stride
+        self._keys: Dict[RoutingKey, RoutingKey] = {}
+
+    def txn(self, t: Optional[TxnId]):
+        if t is None:
+            return None
+        return TxnId(t.epoch, t.hlc + self.hlc_off, t.node, kind=t.kind,
+                     domain=t.domain, extra_flags=t.flags)
+
+    def ts(self, t: Optional[Timestamp]):
+        if t is None:
+            return None
+        if isinstance(t, TxnId):
+            return self.txn(t)
+        return Timestamp(t.epoch, t.hlc + self.hlc_off, t.node, t.flags)
+
+    def key(self, rk: RoutingKey) -> RoutingKey:
+        out = self._keys.get(rk)
+        if out is None:
+            if isinstance(rk, IntKey):
+                out = type(rk)(rk.value + self.key_off, rk.prefix)
+            else:
+                out = rk    # sentinels: span every copy (still exact, wider)
+            self._keys[rk] = out
+        return out
+
+    def rng(self, r: Range) -> Range:
+        return Range(self.key(r.start), self.key(r.end))
+
+
+def rebase_stream(events: List[tuple], copy: int, hlc_stride: int,
+                  key_stride: int) -> List[tuple]:
+    rb = _Rebase(copy, hlc_stride, key_stride)
+    out: List[tuple] = []
+    for ev in events:
+        op = ev[0]
+        if op == "reg":
+            _, tid, st, ea, keys = ev
+            out.append(("reg", rb.txn(tid), st, rb.ts(ea),
+                        tuple(rb.key(k) for k in keys)))
+        elif op == "prune":
+            _, key, ids = ev
+            out.append(("prune", rb.key(key), tuple(rb.txn(t) for t in ids)))
+        elif op == "durable":
+            out.append(("durable", {rb.key(k): rb.txn(b)
+                                    for k, b in ev[1].items()}))
+        elif op == "prefetch":
+            out.append(("prefetch", tuple(
+                (o, rb.txn(by), tuple(rb.key(k) for k in keys), rb.ts(before))
+                for o, by, keys, before in ev[1])))
+        elif op == "kc":
+            _, by, keys, before = ev
+            out.append(("kc", rb.txn(by), tuple(rb.key(k) for k in keys),
+                        rb.ts(before)))
+        elif op == "rc":
+            _, by, r, before = ev
+            out.append(("rc", rb.txn(by), rb.rng(r), rb.ts(before)))
+        elif op == "mc":
+            out.append(("mc", tuple(rb.key(k) for k in ev[1])))
+        elif op == "mcr":
+            out.append(("mcr", rb.rng(ev[1])))
+        else:
+            out.append(ev)
+    return out
+
+
+def interleave(streams: List[List[tuple]]) -> List[tuple]:
+    """Window-aligned merge.  Copies advance in lockstep so the live index
+    holds every copy's in-flight set simultaneously (T multiplies) — but at
+    WINDOW granularity, not event granularity: a naive round-robin would let
+    copy B's ``end_batch`` wipe copy A's prefetched window mid-flight.  The
+    i-th delivery windows of all copies fuse into ONE window: their prefetch
+    specs concatenate into a single batched consult (exactly the
+    across-stores batching the MXU join wants — B multiplies with copies),
+    their bodies run back to back, then one ``end``.  Inter-window events
+    keep per-copy order and are concatenated per segment."""
+    # split each stream into segments: [(pre, specs_or_None, body), ...]
+    split: List[List[Tuple[list, Optional[tuple], list]]] = []
+    for s in streams:
+        segs = []
+        pre: list = []
+        specs = None
+        body: list = []
+        for ev in s:
+            if ev[0] == "prefetch":
+                if specs is not None:        # unterminated window: flush
+                    segs.append((pre, specs, body))
+                    pre, body = [], []
+                specs = ev[1]
+            elif ev[0] == "end":
+                segs.append((pre, specs, body))
+                pre, specs, body = [], None, []
+            elif specs is None:
+                pre.append(ev)
+            else:
+                body.append(ev)
+        if pre or body or specs is not None:
+            segs.append((pre, specs, body))
+        split.append(segs)
+    out: List[tuple] = []
+    n = max(len(s) for s in split)
+    for i in range(n):
+        fused: list = []
+        bodies: list = []
+        for segs in split:
+            if i >= len(segs):
+                continue
+            pre, specs, body = segs[i]
+            out.extend(pre)
+            if specs is not None:
+                fused.extend(specs)
+            bodies.extend(body)
+        if fused:
+            out.append(("prefetch", tuple(fused)))
+        out.extend(bodies)
+        if fused:
+            out.append(("end",))
+    return out
+
+
+_QUERY_OPS = ("kc", "rc", "mc", "mcr", "prefetch", "end")
+
+
+def replay_stream(events: List[tuple], tier: str,
+                  txn_capacity: int, key_capacity: int,
+                  parity_oracle: bool = False,
+                  parity_sample: int = 0) -> dict:
+    """Drive one merged stream through a fresh resolver under ``tier``.
+
+    Returns wall-clock split into mutation and query time, query count, and
+    (with ``parity_sample`` > 0) asserts every Nth query against the cfk walk
+    oracle built on the same shell store."""
+    from ..local.cfk import InternalStatus as IS
+    from ..impl.resolver import CpuDepsResolver
+    from ..impl.tpu_resolver import TpuDepsResolver
+
+    store = ReplayStore()
+    if tier == "walk":
+        resolver: DepsResolver = CpuDepsResolver(store)
+    else:
+        resolver = TpuDepsResolver(store, txn_capacity=txn_capacity,
+                                   key_capacity=key_capacity)
+        resolver.tier = tier
+    oracle = CpuDepsResolver(store) if parity_sample else None
+
+    q_time = 0.0
+    m_time = 0.0
+    queries = 0
+    parity_checked = 0
+    for i, ev in enumerate(events):
+        op = ev[0]
+        t0 = time.perf_counter()
+        if op == "reg":
+            _, tid, st, ea, keys = ev
+            status = IS(st)
+            indexed = tuple(k for k in keys if store.cfk(k).update(tid, status, ea))
+            if indexed:
+                resolver.register(tid, status, ea, indexed)
+            m_time += time.perf_counter() - t0
+        elif op == "prune":
+            _, key, ids = ev
+            cfk = store.cfks.get(key)
+            if cfk is not None:
+                idset = set(ids)
+                pruned = cfk._prune(lambda info: info.txn_id in idset)
+                if pruned:
+                    resolver.on_pruned(key, pruned)
+            m_time += time.perf_counter() - t0
+        elif op == "durable":
+            store.durable_before.by_key.update(ev[1])
+            store.durable_gen += 1
+            m_time += time.perf_counter() - t0
+        elif op == "prefetch":
+            specs = [QuerySpec(o, by, keys, before)
+                     for o, by, keys, before in ev[1]]
+            resolver.prefetch(specs)
+            q_time += time.perf_counter() - t0
+        elif op == "end":
+            resolver.end_batch()
+            q_time += time.perf_counter() - t0
+        elif op == "kc":
+            _, by, keys, before = ev
+            ans = resolver.key_conflicts(by, list(keys), before)
+            q_time += time.perf_counter() - t0
+            queries += 1
+            if oracle is not None and queries % parity_sample == 0:
+                expect = oracle.key_conflicts(by, list(keys), before)
+                check_state(sorted(ans) == sorted(expect),
+                            "replay parity violation (kc) at event %s", i)
+                parity_checked += 1
+        elif op == "rc":
+            _, by, r, before = ev
+            ans = resolver.range_conflicts(by, r, before)
+            q_time += time.perf_counter() - t0
+            queries += 1
+        elif op == "mc":
+            ans = resolver.max_conflict_keys(list(ev[1]))
+            q_time += time.perf_counter() - t0
+            queries += 1
+            if oracle is not None and queries % parity_sample == 0:
+                expect = oracle.max_conflict_keys(list(ev[1]))
+                check_state(ans == expect,
+                            "replay parity violation (mc) at event %s", i)
+                parity_checked += 1
+        elif op == "mcr":
+            ans = resolver.max_conflict_range(ev[1])
+            q_time += time.perf_counter() - t0
+            queries += 1
+
+    out = {"tier": tier, "queries": queries,
+           "query_seconds": round(q_time, 4),
+           "mutation_seconds": round(m_time, 4),
+           "queries_per_sec": round(queries / q_time, 1) if q_time else None,
+           "parity_checked": parity_checked}
+    for tele in ("walk_consults", "host_consults", "device_consults",
+                 "prefetch_hits", "prefetch_patched", "prefetch_misses"):
+        v = getattr(resolver, tele, None)
+        if v:
+            out[tele] = v
+    idx = getattr(resolver, "indexed_count", None)
+    if idx is not None:
+        out["final_indexed"] = idx()
+    return out
+
+
+def record_burn(seed: int = 7, ops: int = 1200, **kw) -> ConsultRecorder:
+    """Run a contended burn with every store's resolver wrapped; returns the
+    recorder (bench entry point)."""
+    from .burn import run_burn
+    rec = ConsultRecorder()
+    kw.setdefault("resolver", "tpu")
+    run_burn(seed=seed, ops=ops, consult_recorder=rec, **kw)
+    return rec
+
+
+def max_hlc_and_key(events: List[tuple]) -> Tuple[int, int, int]:
+    """(max hlc, max IntKey value, distinct key count) — rebasing strides and
+    capacity sizing."""
+    mh, mk = 0, 0
+    distinct = set()
+
+    def see_ts(t):
+        nonlocal mh
+        if t is not None:
+            mh = max(mh, t.hlc)
+
+    def see_key(k):
+        nonlocal mk
+        if isinstance(k, IntKey):
+            mk = max(mk, k.value)
+        distinct.add(k)
+
+    for ev in events:
+        op = ev[0]
+        if op == "reg":
+            see_ts(ev[1]); see_ts(ev[3])
+            for k in ev[4]:
+                see_key(k)
+        elif op == "prune":
+            see_key(ev[1])
+            for t in ev[2]:
+                see_ts(t)
+        elif op == "durable":
+            for k, b in ev[1].items():
+                see_key(k); see_ts(b)
+        elif op == "prefetch":
+            for o, by, keys, before in ev[1]:
+                see_ts(by); see_ts(before)
+                for k in keys:
+                    see_key(k)
+        elif op == "kc":
+            see_ts(ev[1]); see_ts(ev[3])
+            for k in ev[2]:
+                see_key(k)
+        elif op == "rc":
+            see_ts(ev[1]); see_ts(ev[3])
+            see_key(ev[2].start); see_key(ev[2].end)
+        elif op == "mc":
+            for k in ev[1]:
+                see_key(k)
+        elif op == "mcr":
+            see_key(ev[1].start); see_key(ev[1].end)
+    return mh, mk, len(distinct)
+
+
+def scaled_replay(rec: ConsultRecorder, t_target: int, tiers: List[str],
+                  parity_sample: int = 0) -> dict:
+    """Replay enough interleaved copies of the recorded unit stream to grow
+    the live index to ~``t_target``, under each tier."""
+    unit = rec.unit_stream()
+    peak = rec.unit_peak_live()
+    copies = max(1, (t_target + peak - 1) // peak)
+    mh, mk, n_keys = max_hlc_and_key(unit)
+    hlc_stride = mh + 1_000_000
+    key_stride = mk + 1_000
+    merged = interleave([
+        rebase_stream(unit, c, hlc_stride, key_stride) for c in range(copies)])
+    t_cap = 1 << max(6, (copies * peak - 1).bit_length())
+    k_cap = 1 << max(6, (copies * (n_keys + 1) - 1).bit_length())
+    out = {"t_target": t_target, "copies": copies, "unit_events": len(unit),
+           "unit_peak_live": peak, "merged_events": len(merged),
+           "txn_capacity": t_cap, "key_capacity": k_cap,
+           "commits_replayed": rec.unit_commits() * copies, "tiers": {}}
+    for tier in tiers:
+        r = replay_stream(merged, tier, t_cap, k_cap,
+                          parity_sample=parity_sample)
+        total = r["query_seconds"] + r["mutation_seconds"]
+        r["commits_equiv_per_sec"] = round(
+            out["commits_replayed"] / total, 1) if total else None
+        out["tiers"][tier] = r
+    return out

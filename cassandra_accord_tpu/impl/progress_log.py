@@ -174,8 +174,9 @@ class SimpleProgressLog(ProgressLog):
             return
         route = _route_for_participants(blocked_by, blocked_on_route,
                                         blocked_on_participants)
-        if route is None:
-            return
+        # route=None: a txn known only by id (InformOfTxnId-class knowledge) —
+        # still monitored; _resolve_blocked discovers the route first
+        # (FindSomeRoute/RecoverWithSomeRoute capability)
         self.blocking[blocked_by] = _BlockingState(blocked_by, route)
 
     # -- the poll loop (SimpleProgressLog.run) --------------------------------
@@ -279,6 +280,27 @@ class SimpleProgressLog(ProgressLog):
         from ..coordinate.maybe_recover import ProgressToken
         from ..coordinate.recover import invalidate as do_invalidate, recover as do_recover
         from ..utils import async_ as au
+
+        if state.route is None:
+            # route unknown (the txn was learned by id only): discover it
+            # before anything else — RecoverWithSomeRoute (FindSomeRoute ->
+            # RecoverWithRoute, RecoverWithRoute.java:1-242)
+            from ..messages.status_messages import find_some_route
+
+            def on_route(route, failure):
+                current = self.blocking.get(state.txn_id)
+                if current is None:
+                    return
+                if failure is not None or route is None:
+                    # nobody in the cluster knows it yet: back off and retry
+                    # (an InformOfTxn may still be in flight)
+                    current.investigation_failed()
+                    return
+                current.route = route
+                current.progress = Progress.NO_PROGRESS  # escalate next poll
+
+            find_some_route(self.node, state.txn_id).add_listener(on_route)
+            return
 
         def on_fetched(merged, failure):
             current = self.blocking.get(state.txn_id)

@@ -83,7 +83,7 @@ def _register_all() -> None:
               "WaitUntilApplied"]),
         (rm, None),
         (sm, ["CheckStatusOk", "CheckStatus", "InformOfTxn", "InformDurable",
-              "InformHomeDurable", "Propagate"]),
+              "InformHomeDurable", "Propagate", "FindRoute", "FindRouteOk"]),
         (dm, ["SetShardDurable", "SetGloballyDurable", "DurableBeforeReply",
               "QueryDurableBefore"]),
         (em, ["GetEphemeralReadDepsOk", "GetEphemeralReadDeps",

@@ -70,6 +70,8 @@ class MessageType(enum.Enum):
     QUERY_DURABLE_BEFORE_REQ = ("QUERY_DURABLE_BEFORE_REQ", False)
     QUERY_DURABLE_BEFORE_RSP = ("QUERY_DURABLE_BEFORE_RSP", False)
     INFORM_OF_TXN_REQ = ("INFORM_OF_TXN_REQ", True)
+    FIND_ROUTE_REQ = ("FIND_ROUTE_REQ", False)
+    FIND_ROUTE_RSP = ("FIND_ROUTE_RSP", False)
     INFORM_DURABLE_REQ = ("INFORM_DURABLE_REQ", True)
     INFORM_HOME_DURABLE_REQ = ("INFORM_HOME_DURABLE_REQ", True)
     # local-only message types (Propagate family)

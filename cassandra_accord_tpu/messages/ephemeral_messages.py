@@ -113,9 +113,14 @@ class ReadEphemeralTxnData(TxnRequest):
                 node.message_sink.reply_with_unknown_failure(from_node, reply_context,
                                                              failure)
                 return
-            if any(d == "unavailable" for d in datas):
-                node.reply(from_node, reply_context, ReadNack("unavailable"))
-                return
+            # string sentinels from the data plane: "unavailable" (bootstrap)
+            # and "obsolete" (stale-marked key — read_chain propagates it so
+            # a gapped replica never silently serves a non-prefix snapshot)
+            for sentinel, reason in (("unavailable", "unavailable"),
+                                     ("obsolete", "obsolete")):
+                if any(d == sentinel for d in datas):
+                    node.reply(from_node, reply_context, ReadNack(reason))
+                    return
             merged = None
             for d in datas:
                 if d is None:

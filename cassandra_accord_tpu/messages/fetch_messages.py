@@ -20,34 +20,50 @@ if TYPE_CHECKING:
 
 
 class FetchStoreDataOk(Reply):
-    """entries: key -> [(executeAt, value), ...] for every key in the ranges."""
+    """entries: key -> [(executeAt, value), ...] for every key in the ranges.
+    ``partial`` marks a source that itself has stale (gapped) data on the
+    ranges: its entries are merge-safe (committed writes, timestamp-ordered)
+    but not individually complete — union-heal counts it toward the
+    quorum-intersection bound instead of treating it as authoritative."""
 
-    __slots__ = ("entries",)
+    __slots__ = ("entries", "partial")
 
-    def __init__(self, entries: Dict):
+    def __init__(self, entries: Dict, partial: bool = False):
         self.entries = entries
+        self.partial = partial
 
     @property
     def type(self):
         return MessageType.FETCH_DATA_RSP
 
     def __repr__(self):
-        return f"FetchStoreDataOk({len(self.entries)} keys)"
+        tag = ", partial" if self.partial else ""
+        return f"FetchStoreDataOk({len(self.entries)} keys{tag})"
 
 
 class FetchStoreData(Request):
     """Stream the data-store contents for ``ranges`` to a bootstrapping replica.
     The source first waits until the fencing sync point has applied LOCALLY
     (ApplyThenWaitUntilApplied semantics): a source lagging behind the fence
-    would otherwise serve a snapshot missing quorum-applied writes."""
+    would otherwise serve a snapshot missing quorum-applied writes.
 
-    __slots__ = ("ranges", "sync_txn_id", "sync_route")
+    ``allow_stale``: union-heal mode (gap healing, not bootstrap) — a source
+    whose own data is stale-marked still serves what it has, flagged partial.
+    Any f+1 replicas' union contains every quorum-applied write (an apply
+    quorum and f+1 responders must intersect), so the healer can clear its
+    stale mark from enough partial snapshots even when EVERY replica of the
+    range is gapped — without this, mutually-stale replicas deadlock refusing
+    each other and the range stays read-unavailable forever (the chaos+churn
+    burns stalled exactly there)."""
+
+    __slots__ = ("ranges", "sync_txn_id", "sync_route", "allow_stale")
 
     def __init__(self, ranges: Ranges, sync_txn_id: Optional[TxnId] = None,
-                 sync_route=None):
+                 sync_route=None, allow_stale: bool = False):
         self.ranges = ranges
         self.sync_txn_id = sync_txn_id
         self.sync_route = sync_route
+        self.allow_stale = allow_stale
 
     @property
     def type(self):
@@ -66,12 +82,14 @@ class FetchStoreData(Request):
                     from_node, reply_context,
                     RuntimeError("source bootstrapping requested ranges"))
                 return
-        # likewise a source with its OWN known data gaps on these ranges
-        # (stale marks): serving its snapshot would 'heal' the fetcher with
-        # the same hole and clear the fetcher's stale mark over an open gap
+        # a source with its OWN known data gaps on these ranges (stale marks):
+        # a BOOTSTRAP fetch treats one source as authoritative and must refuse
+        # (serving would 'heal' the fetcher with the same hole); a union-heal
+        # fetch (allow_stale) serves what it has, flagged partial
         src_stale = getattr(node.data_store, "stale_ranges", None)
-        if src_stale is not None and len(src_stale) \
-                and src_stale.intersects(self.ranges):
+        is_partial = (src_stale is not None and len(src_stale)
+                      and src_stale.intersects(self.ranges))
+        if is_partial and not self.allow_stale:
             node.message_sink.reply_with_unknown_failure(
                 from_node, reply_context,
                 RuntimeError("source has stale (gapped) data on requested ranges"))
@@ -95,7 +113,8 @@ class FetchStoreData(Request):
                     rk = key.to_routing() if hasattr(key, "to_routing") else key
                     if self.ranges.contains(rk):
                         entries[key] = list(values)
-            node.reply(from_node, reply_context, FetchStoreDataOk(entries))
+            node.reply(from_node, reply_context,
+                       FetchStoreDataOk(entries, partial=is_partial))
 
         if self.sync_txn_id is None or self.sync_route is None:
             serve()

@@ -326,11 +326,13 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
 
     def current_plan(open_rngs):
         """PER-SHARD fetch plan against the CURRENT topology: the stale mark
-        may only clear when EVERY shard slice of the footprint was healed by a
-        replica of THAT shard (one Ok from a different shard's peer says
+        may only clear when EVERY shard slice of the footprint was healed by
+        replicas of THAT shard (an Ok from a different shard's peer says
         nothing about this slice).  Recomputed each retry round — replicas
         replaced under topology churn must not leave the heal retrying a
-        stale peer list forever."""
+        stale peer list forever.  Each entry carries the union-heal bound:
+        enough responders (self included) that any apply quorum intersects
+        them."""
         topology = node.config_service.current_topology()
         plan = []
         for shard in topology.shards:
@@ -338,7 +340,19 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
             if len(sub):
                 peers = sorted(n for n in shard.nodes if n != node.id)
                 if peers:
-                    plan.append((sub, peers))
+                    # UNION-HEAL soundness: every write below the durable
+                    # fence applied at a slow-path quorum q of n; any
+                    # responder set of size >= n - q + 1 intersects every
+                    # such quorum, so the union of responders' snapshots
+                    # (self included — its data is already local) contains
+                    # every fenced write.  Stale/partial sources count:
+                    # their entries are committed writes, merge-safe.
+                    # Floor at one PEER response so a gapped replica never
+                    # self-certifies.
+                    need_peers = max(1, len(shard.nodes)
+                                     - shard.slow_path_quorum_size + 1
+                                     - 1)   # minus self
+                    plan.append((sub, peers, min(need_peers, len(peers))))
         return plan
 
     if not current_plan(rngs):
@@ -368,31 +382,35 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
                 else:
                     node.scheduler.once(delay, lambda: attempt(next_delay))
 
-        def slice_attempt(sub, peers) -> None:
-            st = {"pending": len(peers), "healed": False}
+        def slice_attempt(sub, peers, need: int) -> None:
+            st = {"pending": len(peers), "got": 0}
 
             class HealCallback(Callback):
                 def on_success(self, from_node: int, reply) -> None:
                     st["pending"] -= 1
                     if isinstance(reply, FetchStoreDataOk):
-                        st["healed"] = True
+                        # a NON-partial snapshot (source past the fence with
+                        # no gaps of its own) is authoritative alone; partial
+                        # (gapped-source) snapshots count toward the
+                        # quorum-intersection bound
+                        st["got"] += need if not reply.partial else 1
                         for key, entries in reply.entries.items():
                             for ts, value in entries:
                                 store.append(key, ts, value)
                     if st["pending"] == 0:
-                        slice_done(sub, st["healed"])
+                        slice_done(sub, st["got"] >= need)
 
                 def on_failure(self, from_node: int, failure: BaseException) -> None:
                     st["pending"] -= 1
                     if st["pending"] == 0:
-                        slice_done(sub, st["healed"])
+                        slice_done(sub, st["got"] >= need)
 
             callback = HealCallback()
             for to in peers:
-                node.send(to, FetchStoreData(sub), callback)
+                node.send(to, FetchStoreData(sub, allow_stale=True), callback)
 
-        for sub, peers in plan:
-            slice_attempt(sub, peers)
+        for sub, peers, need in plan:
+            slice_attempt(sub, peers, need)
 
     attempt(2.0)
 
@@ -427,6 +445,119 @@ class InformOfTxn(TxnRequest):
 
     def __repr__(self):
         return f"InformOfTxn({self.txn_id!r})"
+
+
+class FindRoute(Request):
+    """Route discovery for a txn known only by id (FindRoute.java /
+    FindSomeRoute.java capability): ask a node whether ANY of its stores
+    witnessed the txn, and reply with the route (and how much it knows).
+    Unlike every Txn request this is NOT scope-sliced — the asker has no
+    route to slice by; the whole point is to learn one."""
+
+    __slots__ = ("txn_id",)
+
+    def __init__(self, txn_id: TxnId):
+        self.txn_id = txn_id
+
+    @property
+    def type(self):
+        return MessageType.FIND_ROUTE_REQ
+
+    def process(self, node: "Node", from_node: int, reply_context) -> None:
+        from ..utils import async_ as au
+        txn_id = self.txn_id
+
+        def map_fn(safe_store: SafeCommandStore):
+            cmd = safe_store.get_if_exists(txn_id)
+            if cmd is not None and cmd.route is not None:
+                return (cmd.route, cmd.save_status.ordinal)
+            return None
+
+        def reduce(a, b):
+            if a is None:
+                return b
+            if b is None:
+                return a
+            route = a[0] if _route_wider(a[0], b[0]) else b[0]
+            return (route, max(a[1], b[1]))
+
+        def consume(result, failure):
+            if failure is not None:
+                node.message_sink.reply_with_unknown_failure(
+                    from_node, reply_context, failure)
+                return
+            route, ordinal = result if result is not None else (None, 0)
+            node.reply(from_node, reply_context,
+                       FindRouteOk(txn_id, route, ordinal))
+
+        chains = [s.submit(map_fn) for s in node.command_stores.all_stores()]
+
+        def reduce_all(results):
+            acc = None
+            for r in results:
+                acc = reduce(acc, r)
+            return acc
+
+        au.all_of(chains).map(reduce_all).begin(consume)
+
+    def __repr__(self):
+        return f"FindRoute({self.txn_id!r})"
+
+
+def _route_wider(a: Route, b: Route) -> bool:
+    """Prefer full routes, then more participants."""
+    if a.full != b.full:
+        return a.full
+    return len(a.participants()) >= len(b.participants())
+
+
+class FindRouteOk(Reply):
+    __slots__ = ("txn_id", "route", "status_ordinal")
+
+    def __init__(self, txn_id: TxnId, route: Optional[Route], status_ordinal: int):
+        self.txn_id = txn_id
+        self.route = route
+        self.status_ordinal = status_ordinal
+
+    @property
+    def type(self):
+        return MessageType.FIND_ROUTE_RSP
+
+    def __repr__(self):
+        return f"FindRouteOk({self.txn_id!r}, {self.route!r})"
+
+
+def find_some_route(node: "Node", txn_id: TxnId) -> "au.AsyncResult":
+    """Ask EVERY node in the current topology for the txn's route
+    (FindSomeRoute semantics: any replica that witnessed it suffices).
+    Resolves with the widest Route found, or None if nobody knows."""
+    from ..utils import async_ as au
+    result = au.settable()
+    targets = sorted(node.config_service.current_topology().nodes())
+    state = {"pending": len(targets), "route": None}
+
+    class RouteCallback(Callback):
+        def on_success(self, from_node: int, reply) -> None:
+            if isinstance(reply, FindRouteOk) and reply.route is not None:
+                if state["route"] is None \
+                        or _route_wider(reply.route, state["route"]):
+                    state["route"] = reply.route
+            self._one()
+
+        def on_failure(self, from_node: int, failure: BaseException) -> None:
+            self._one()
+
+        def _one(self) -> None:
+            state["pending"] -= 1
+            if state["pending"] == 0 and not result.is_done():
+                result.set_success(state["route"])
+
+    callback = RouteCallback()
+    for to in targets:
+        node.send(to, FindRoute(txn_id), callback)
+    if not targets:
+        result.set_success(None)
+    return result
 
 
 class InformDurable(TxnRequest):

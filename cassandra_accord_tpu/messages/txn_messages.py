@@ -519,11 +519,19 @@ def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
             node.reply(from_node, reply_context, ReadNack("unavailable"))
             return
         merged = None
+        unavailable = Ranges.EMPTY
         for d in datas:
             if d is None:
                 continue
+            if isinstance(d, tuple) and d and d[0] == "partial":
+                _tag, data, un = d
+                unavailable = unavailable.union(un)
+                d = data
+                if d is None:
+                    continue
             merged = d if merged is None else merged.merge(d)
-        node.reply(from_node, reply_context, ReadOk(merged))
+        node.reply(from_node, reply_context, ReadOk(
+            merged, unavailable=unavailable if len(unavailable) else None))
 
     au.all_of(chains).begin(consume)
 
@@ -549,24 +557,53 @@ def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncCha
             result.set_success("obsolete")
             return True
         if command.save_status is SaveStatus.READY_TO_EXECUTE:
-            # bootstrap in progress: the data for these ranges is incomplete
-            # here — refuse so the coordinator reads another replica
-            # (ReadData unavailable semantics)
-            if command.partial_txn is not None and s.store.pending_bootstrap \
-                    and command.partial_txn.intersects(s.store.pending_bootstrap):
-                result.set_success("unavailable")
-                return True
             # read against the ranges owned at the EXECUTION epoch (they may
             # have been dropped in a later one; the data is still here)
             ranges = s.store.ranges_at(command.execute_at.epoch) \
                 if command.execute_at is not None else s.store.current_ranges()
+            # bootstrap in progress: data for the PENDING ranges is incomplete
+            # here (deps on them may be bootstrap-elided; their writes arrive
+            # only with the fetch) — serve the CLEAN slice and report the
+            # pending remainder as unavailable so the coordinator can assemble
+            # full coverage across replicas (partial reads; ReadData
+            # unavailable semantics + ReadCoordinator).  Refusing whole reads
+            # on ANY overlap deadlocked chaos+churn burns cluster-wide: wide
+            # range reads always overlapped SOME pending range at every
+            # replica, while the bootstrap fences waited on the very txns
+            # whose reads were being refused.
+            pending = s.store.pending_bootstrap
+            unavailable = Ranges.EMPTY
+            if command.partial_txn is not None and pending:
+                k = command.partial_txn.keys
+                if isinstance(k, Ranges):
+                    unavailable = k.intersection(ranges).intersection(pending)
+                else:
+                    hit = [rk for rk in (
+                        key.to_routing() if hasattr(key, "to_routing") else key
+                        for key in k)
+                        if ranges.contains(rk) and pending.contains(rk)]
+                    if hit:
+                        unavailable = ranges.intersection(pending)
+                if len(unavailable):
+                    ranges = ranges.without(pending)
             read_keys = command.partial_txn.keys.intersection(ranges) \
                 if isinstance(command.partial_txn.keys, Ranges) \
                 else [k for k in command.partial_txn.keys
                       if ranges.contains(k.to_routing() if hasattr(k, "to_routing") else k)]
-            command.partial_txn.read_chain(s, command.execute_at, read_keys).begin(
-                lambda data, f: result.set_failure(f) if f is not None
-                else result.set_success(data))
+
+            def done(data, f, unavailable=unavailable):
+                if f is not None:
+                    result.set_failure(f)
+                elif isinstance(data, str):
+                    # sentinel ("obsolete"): the store cannot serve this read
+                    result.set_success(data)
+                elif len(unavailable):
+                    result.set_success(("partial", data, unavailable))
+                else:
+                    result.set_success(data)
+
+            command.partial_txn.read_chain(s, command.execute_at, read_keys) \
+                .begin(done)
             return True
         return False
 

@@ -110,6 +110,10 @@ class Txn:
             for d in datas:
                 if d is None:
                     continue
+                if isinstance(d, str):
+                    # per-key sentinel from the data store ("obsolete" on a
+                    # stale-marked key): the whole store read reports it
+                    return d
                 merged = d if merged is None else merged.merge(d)
             return merged
 

@@ -1,0 +1,144 @@
+"""Per-phase coordinator tests on the controllable-reply MockCluster.
+
+Parity target: accord/coordinate/CoordinateTransactionTest.java:1-438 with
+impl/mock/MockCluster — hand-crafted reply sequences driving the coordinator
+into states that are hard to reach organically (preemption mid-phase, lost
+rounds, stale status evidence).
+"""
+import pytest
+
+from cassandra_accord_tpu.coordinate.errors import (CoordinationFailed,
+                                                    Exhausted, Preempted,
+                                                    Timeout as CoordTimeout)
+from cassandra_accord_tpu.harness.mock import MockCluster
+from cassandra_accord_tpu.impl.list_store import ListResult
+from cassandra_accord_tpu.messages.txn_messages import (AcceptNack,
+                                                        PreAcceptOk)
+from cassandra_accord_tpu.primitives.keys import IntKey
+from cassandra_accord_tpu.primitives.timestamp import Ballot, Timestamp
+
+
+def _result(res):
+    out = {}
+    res.add_listener(lambda v, f: out.update(v=v, f=f))
+    return out
+
+
+def test_mock_happy_path():
+    mc = MockCluster()
+    out = _result(mc.coordinate(1, mc.write_txn({IntKey(5): "a"})))
+    assert mc.run_until(lambda: out)
+    assert out["f"] is None and isinstance(out["v"], ListResult)
+
+
+def test_mock_release_delivers_normally():
+    mc = MockCluster()
+    ic = mc.intercept("PreAccept", count=1)
+    out = _result(mc.coordinate(1, mc.write_txn({IntKey(5): "a"})))
+    held = mc.await_held(ic, 1)
+    assert held[0].to_node in (1, 2, 3)
+    held[0].release()
+    assert mc.run_until(lambda: out)
+    assert out["f"] is None
+
+
+def test_slow_path_preempted_mid_accept():
+    """A crafted PreAcceptOk with a LATER witnessed timestamp forces the slow
+    path; an AcceptNack naming a higher ballot then preempts the Accept round
+    (CoordinateTransactionTest preemption coverage)."""
+    mc = MockCluster()
+    pre_ic = mc.intercept("PreAcceptOk", count=0)  # placeholder (requests only)
+    ic = mc.intercept("PreAccept", to_node=2, count=1)
+    out = _result(mc.coordinate(1, mc.write_txn({IntKey(5): "a"})))
+    held = mc.await_held(ic, 1)
+    req = held[0].request
+    # conflict evidence: witnessed at a later timestamp than txnId
+    later = Timestamp(req.txn_id.epoch, req.txn_id.hlc + 999, 2)
+    from cassandra_accord_tpu.primitives.deps import Deps
+    held[0].reply(PreAcceptOk(req.txn_id, later, Deps.NONE))
+    # slow path now runs Accept: nack it with a higher ballot
+    acc_ic = mc.intercept("Accept", to_node=3, count=1)
+    acc = mc.await_held(acc_ic, 1)
+    high = Ballot(req.txn_id.epoch, req.txn_id.hlc + 10_000, 9)
+    acc[0].reply(AcceptNack(req.txn_id, high))
+    assert mc.run_until(lambda: out)
+    assert isinstance(out["f"], Preempted)
+
+
+def test_lost_stable_round_exhausts():
+    """Dropping every Stable/Commit request starves the stabilise quorum; the
+    coordinator reports the coordination failed rather than hanging (the
+    reply-timeout plane drives it)."""
+    mc = MockCluster()
+    ic = mc.intercept("Commit", count=1_000_000)
+    out = _result(mc.coordinate(1, mc.write_txn({IntKey(5): "a"})))
+    # hold (and drop) every commit; reply-timeouts fire at ~2s sim
+    mc.run_until(lambda: len(ic.held) >= 3)
+    for h in list(ic.held):
+        if not h.done:
+            h.drop()
+    assert mc.run_until(lambda: out, sim_limit_s=30.0)
+    assert isinstance(out["f"], CoordinationFailed)
+
+
+def test_routeless_blocked_txn_discovers_route_and_settles():
+    """A node that learns a txnId WITHOUT its route (InformOfTxnId-class
+    knowledge) discovers the route via FindSomeRoute and drives the txn
+    terminal (RecoverWithSomeRoute capability, RecoverWithRoute.java:1-242)."""
+    from cassandra_accord_tpu.local.status import SaveStatus, Status
+
+    mc = MockCluster(progress_log=True)
+    # a txn that reaches PreAccepted on SOME nodes but whose coordinator dies
+    # (every Accept/Commit swallowed -> no progress); key 5's replicas all know
+    # the route, the blocked observer does not
+    ic_acc = mc.intercept("Accept", count=10**6)
+    ic_cmt = mc.intercept("Commit", count=10**6)
+    out = _result(mc.coordinate(1, mc.write_txn({IntKey(5): "x"})))
+    mc.run_for(0.2)
+    # find the txn id that got preaccepted
+    node2 = mc.node(2)
+    store2 = node2.command_stores.all_stores()[0]
+    pre = [tid for tid, cmd in store2.commands.items()
+           if cmd.route is not None]
+    assert pre, "txn never preaccepted anywhere"
+    tid = pre[0]
+    # node 3 learns the id ONLY (no route): blocked-dependency monitoring
+    node3 = mc.node(3)
+    store3 = node3.command_stores.all_stores()[0]
+    store3.progress_log.waiting(tid, None, None, None)
+    # the dead coordinator stays dead, but recovery's own rounds must flow
+    ic_acc.remaining = 0
+    ic_cmt.remaining = 0
+    # discovery + escalation drive it to a terminal state cluster-wide
+    def terminal():
+        cmd = store3.lookup(tid)
+        return cmd is not None and (
+            cmd.save_status.ordinal >= SaveStatus.APPLIED.ordinal
+            or cmd.save_status is SaveStatus.INVALIDATED
+            or cmd.save_status.is_truncated)
+    assert mc.run_until(terminal, sim_limit_s=60.0), \
+        f"blocked routeless txn never settled: {store3.lookup(tid)!r}"
+
+
+def test_stale_check_status_escalates_to_invalidation():
+    """A txn witnessed nowhere: maybe_recover's CheckStatus probes get empty
+    (stale) evidence from a quorum, the definition is unrecoverable, and the
+    blocked txn is invalidated so nothing waits on it forever."""
+    from cassandra_accord_tpu.coordinate.maybe_recover import (ProgressToken,
+                                                               maybe_recover)
+    from cassandra_accord_tpu.primitives.route import Route
+    from cassandra_accord_tpu.primitives.timestamp import Domain, TxnId, TxnKind
+
+    from cassandra_accord_tpu.primitives.keys import RoutingKeys
+    mc = MockCluster()
+    node = mc.node(1)
+    ghost = node.next_txn_id(TxnKind.WRITE, Domain.KEY)
+    rk = IntKey(5).to_routing() if hasattr(IntKey(5), "to_routing") else IntKey(5)
+    route = Route.for_keys(rk, RoutingKeys.of([rk]))
+    # a zero prev-token: the first probe's identical evidence is NOT progress,
+    # so the probe escalates immediately instead of standing down one cycle
+    out = _result(maybe_recover(node, ghost, route, ProgressToken()))
+    assert mc.run_until(lambda: out, sim_limit_s=30.0)
+    # durably invalidated: settled, nothing can block on it
+    assert out["f"] is None
+    assert out["v"].settled
