@@ -222,41 +222,118 @@ def bench_graph(t=8192, iters=3):
     return out
 
 
+def probe_device(timeout_s: int = 120) -> bool:
+    """Check the TPU is actually reachable — in a SUBPROCESS, because a wedged
+    axon tunnel blocks inside native code at jax import (uninterruptible
+    in-process).  First compile over the tunnel takes 20-40s; allow slack."""
+    import subprocess
+    import sys
+    code = ("import jax, jax.numpy as jnp; "
+            "y = (jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready(); "
+            "print('device-ok', jax.devices()[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout_s,
+                           capture_output=True, text=True)
+        return r.returncode == 0 and "device-ok" in r.stdout
+    except Exception:  # noqa: BLE001 — timeout or spawn failure: no device
+        return False
+
+
+def _strip_axon_and_go_cpu():
+    """Re-exec with the axon site stripped so NOTHING can touch the wedged
+    tunnel (even `import jax` hangs while its plugin dials the dead relay)."""
+    if os.environ.get("ACCORD_BENCH_CPU") == "1":
+        return
+    os.environ["ACCORD_BENCH_CPU"] = "1"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p) or os.path.dirname(os.path.abspath(__file__))
+    import sys
+    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
+              os.environ)
+
+
+def bench_trace_replay(device: bool):
+    """The trace-driven data-plane bench (VERDICT r03 item 1a): record the
+    FULL consult stream of a contended burn — every registration, prune,
+    durability-gate advance, delivery-window prefetch, and query exactly as
+    the protocol issued them — then replay N identity-rebased copies into one
+    resolver so the index reaches data-plane scale, under each execution
+    tier.  Protocol semantics, device engaged, sampled parity vs the cfk
+    oracle on the same state."""
+    from cassandra_accord_tpu.harness.consult_trace import (record_burn,
+                                                            scaled_replay)
+    # persistent f32 host-tier mirrors at replay scale: the honest host
+    # baseline should not pay per-call casts (memory is plentiful host-side)
+    os.environ["ACCORD_TPU_F32_MAX"] = str(1 << 20)
+    rec = record_burn(seed=PROTO_SEED, ops=PROTO_OPS, concurrency=PROTO_CONC,
+                      batch_window_us=TPU_WINDOW_US, **PROTO_KW)
+    tiers = ["walk", "host"] + (["device", "auto"] if device else [])
+    out = {}
+    for t_target in (4096, 32768):
+        out[f"T{t_target}"] = scaled_replay(rec, t_target, tiers,
+                                            parity_sample=500)
+    return out
+
+
 def main():
+    device = probe_device()
+    if not device:
+        _strip_axon_and_go_cpu()
     # warm the jit caches so protocol timing measures steady state, not compiles
     bench_protocol("tpu", batch_window_us=TPU_WINDOW_US, ops=40, reps=1)
     tpu_cps, tpu_res = bench_protocol("tpu", batch_window_us=TPU_WINDOW_US)
     cpu_cps, cpu_res = bench_protocol("cpu", batch_window_us=0)
     assert tpu_res.ops_ok == cpu_res.ops_ok, "workload mismatch"
     tel = {k: v for k, v in tpu_res.stats.items() if k.startswith("resolver_")}
-    kernels = [
-        bench_kernel(4096),
-        bench_kernel(65536),
-        bench_kernel(65536, packed=True),                     # 8x less transfer
-        # BASELINE config 4: multi-key range txns, 1k keys/txn wide join
-        bench_kernel(65536, k=2048, b=64, keys_per_txn=1024, packed=True),
-    ]
-    graph = bench_graph()                                     # BASELINE config 5
+    replay = bench_trace_replay(device)
+    kernels = []
+    graph = None
+    if device:
+        kernels = [
+            bench_kernel(4096),
+            bench_kernel(65536),
+            bench_kernel(65536, packed=True),                 # 8x less transfer
+            # BASELINE config 4: multi-key range txns, 1k keys/txn wide join
+            bench_kernel(65536, k=2048, b=64, keys_per_txn=1024, packed=True),
+        ]
+        graph = bench_graph()                                 # BASELINE config 5
+    # headline: protocol-semantics consult traffic at data-plane scale, the
+    # fastest engaged tier at T=32k vs the scalar cfk walk on the SAME stream
+    big = replay["T32768"]["tiers"]
+    walk_ce = big["walk"]["commits_equiv_per_sec"] or 1.0
+    best_tier = max((t for t in big if t != "walk"),
+                    key=lambda t: big[t]["commits_equiv_per_sec"] or 0.0)
+    best_ce = big[best_tier]["commits_equiv_per_sec"] or 0.0
     print(json.dumps({
-        "metric": "protocol_commits_per_sec_tpu_dataplane",
-        "value": round(tpu_cps, 1),
-        "unit": "commits/s",
-        "vs_baseline": round(tpu_cps / cpu_cps, 3),
+        "metric": "consult_replay_commits_equiv_per_sec_T32k",
+        "value": round(best_ce, 1),
+        "unit": "commits-equiv/s",
+        "vs_baseline": round(best_ce / walk_ce, 3),
         "detail": {
-            "baseline": "same cluster+seed+workload under resolver=cpu "
-                        "(host cfk walk; this repo's Python host plane, "
-                        "NOT the reference JVM)",
-            "note": "the tpu data plane is two-tier (vectorized-host / MXU "
-                    "device) behind a cost model; at this workload's index "
-                    "size the cost model selects the host tier (device "
-                    "dispatch over the axon tunnel costs ~10ms RTT) — see "
-                    "tpu_resolver_telemetry tier counts and kernel_scaling "
-                    "for where the device tier engages",
-            "protocol_commits_per_sec_cpu_resolver": round(cpu_cps, 1),
-            "workload": {"ops": PROTO_OPS, "concurrency": PROTO_CONC,
-                         **PROTO_KW, "seed": PROTO_SEED,
-                         "tpu_batch_window_us": TPU_WINDOW_US},
-            "tpu_resolver_telemetry": tel,
+            "baseline": "the scalar per-key cfk walk (the reference "
+                        "algorithm's shape) replaying the SAME recorded "
+                        "protocol consult stream on the same shell state",
+            "headline_tier": best_tier,
+            "device_present": device,
+            "trace_replay": replay,
+            "north_star": "BASELINE.md targets 10x conflicting-txn commit "
+                          "throughput at deps parity; this bench replays "
+                          "REAL protocol consult streams (not synthetic "
+                          "arrays) at T in {4k, 32k} — see trace_replay for "
+                          "where each tier stands and kernel_scaling for raw "
+                          "MXU rates; the end-to-end sim remains Python-"
+                          "control-plane-bound (see protocol_end_to_end)",
+            "protocol_end_to_end": {
+                "commits_per_sec_tpu_dataplane": round(tpu_cps, 1),
+                "commits_per_sec_cpu_resolver": round(cpu_cps, 1),
+                "ratio": round(tpu_cps / cpu_cps, 3),
+                "workload": {"ops": PROTO_OPS, "concurrency": PROTO_CONC,
+                             **PROTO_KW, "seed": PROTO_SEED,
+                             "tpu_batch_window_us": TPU_WINDOW_US},
+                "tpu_resolver_telemetry": tel,
+            },
             "kernel_scaling": kernels,
             "graph_kernels": graph,
         },

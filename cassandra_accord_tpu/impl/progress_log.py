@@ -59,10 +59,10 @@ class _MonitorState:
         self.cooldown = 0
 
     def investigation_failed(self) -> None:
-        # cap high enough that, across several contending monitors, one
-        # eventually gets a window longer than a full recovery pipeline
-        # (reads over delayed stores can take seconds of sim time)
-        self.backoff = min(self.backoff * 2 + 1, 32)
+        # ballot-only movement no longer resets the backoff (material-advance
+        # gating), so mutual preemption decays on its own — the cap can stay
+        # low for fast retries once the cluster heals
+        self.backoff = min(self.backoff * 2 + 1, 8)
         self.cooldown = self.backoff
         self.progress = Progress.NO_PROGRESS
 

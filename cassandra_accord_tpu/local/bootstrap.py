@@ -64,7 +64,14 @@ class Bootstrap:
             from .durability import RedundantBefore
             self.store.redundant_before = self.store.redundant_before.merge(
                 RedundantBefore.of(self.ranges, bootstrapped_at=txn_id))
-            _reevaluate_waiting(safe_store)
+            # re-evaluate pre-existing waiters ONCE per bootstrap (and again on
+            # finish): the new fence's own WaitingOn is built AFTER this mark
+            # and elides via the live bounds, so retry rungs gain nothing from
+            # a rescan — per-rung rescans made churn quiesce O(rungs x edges)
+            # (waiters whose deps fall between successive fence ids drain via
+            # the progress log or the finish re-evaluation)
+            if self.attempts == 0:
+                _reevaluate_waiting(safe_store, self.ranges)
             self.node.sync_point(self.ranges, exclusive=True, blocking=True,
                                  txn_id=txn_id).add_listener(self._on_sync_point)
 
@@ -114,32 +121,38 @@ class Bootstrap:
             store.redundant_before = store.redundant_before.merge(
                 RedundantBefore.of(self.ranges, bootstrapped_at=sync_point.txn_id))
             store.pending_bootstrap = store.pending_bootstrap.without(self.ranges)
-            _reevaluate_waiting(safe_store)
+            _reevaluate_waiting(safe_store, self.ranges)
             self.result.set_success(sync_point)
 
         self.store.execute(finish)
 
 
-def _reevaluate_waiting(safe_store) -> None:
+def _reevaluate_waiting(safe_store, ranges=None) -> None:
     """Drop now-redundant (pre-bootstrap) deps from every waiting command and
     try to execute it (Commands re-evaluation after bootstrappedAt advances).
 
     Runs on every bootstrap mark/finish — including each rung of the retry
-    ladder — so the scan is gated by the store-wide MAX locally-redundant
-    bound: is_locally_redundant requires the dep below the bound at EVERY
-    footprint point, so any dep at/above the max bound anywhere is
-    unprunable and skipped with one comparison instead of an interval-map
-    sweep (the hostile churn matrix spent >30% of its time in the sweeps)."""
+    ladder — so the scan is aggressively filtered:
+
+    - ``ranges``: the mark only advanced bounds on the bootstrapped ranges, so
+      only deps whose footprint intersects them can have become redundant;
+    - store-wide max-bound gate (a dep at/above the max locally-redundant
+      bound anywhere is unprunable);
+    - per-edge participants are cached on the store (immutable per deps
+      object; rebuilding the key unions per rung dominated churn quiesce);
+    - redundancy verdicts are memoised per (dep, footprint) within a pass."""
     from . import commands as C
     store = safe_store.store
     redundant = store.redundant_before
     max_bound = redundant.max_locally_redundant_over(store.all_ranges())
     if max_bound is None:
         return
-    # hot conflicts repeat across waiters with identical per-store dep slices:
-    # memoise the redundancy verdict per (dep, footprint) so each distinct
-    # sweep runs once per re-evaluation instead of once per waiting edge
     memo: dict = {}
+    parts_cache = getattr(store, "_dep_parts_cache", None)
+    if parts_cache is None:
+        parts_cache = store._dep_parts_cache = {}
+    elif len(parts_cache) > 50_000:
+        parts_cache.clear()
     for command in list(store.commands.values()):
         waiting = command.waiting_on
         if waiting is None or not waiting.is_waiting():
@@ -148,11 +161,21 @@ def _reevaluate_waiting(safe_store) -> None:
         for dep_id in list(waiting.waiting):
             if not dep_id < max_bound:
                 continue
-            parts = deps.participants(dep_id) if deps is not None else None
+            ck = (command.txn_id, dep_id)
+            ent = parts_cache.get(ck)
+            if ent is None or ent[0] is not deps:
+                parts = deps.participants(dep_id) if deps is not None else None
+                if parts is None:
+                    parts_cache[ck] = (deps, None, None)
+                    continue
+                keys, rngs = parts
+                mk = (dep_id, tuple(keys), tuple((r.start, r.end) for r in rngs))
+                ent = parts_cache[ck] = (deps, parts, mk)
+            _d, parts, mk = ent
             if parts is None:
                 continue
-            keys, rngs = parts
-            mk = (dep_id, tuple(keys), tuple((r.start, r.end) for r in rngs))
+            if ranges is not None and not _parts_intersect(parts, ranges):
+                continue
             hit = memo.get(mk)
             if hit is None:
                 hit = memo[mk] = redundant.is_locally_redundant(dep_id, parts)
@@ -164,3 +187,14 @@ def _reevaluate_waiting(safe_store) -> None:
                     dep.listeners.discard(command.txn_id)
         if not waiting.is_waiting():
             C.maybe_execute(safe_store, command, always_notify_listeners=False)
+
+
+def _parts_intersect(parts, ranges: Ranges) -> bool:
+    keys, rngs = parts
+    for k in keys:
+        if ranges.contains(k):
+            return True
+    for r in rngs:
+        if ranges.intersects(Ranges.of(r)):
+            return True
+    return False

@@ -447,7 +447,7 @@ class Commit(TxnRequest):
             if self.read:
                 node.reply(from_node, reply_context, STABLE_ACK)
                 execute_read(node, from_node, reply_context, txn_id, self.scope,
-                             self.execute_at)
+                             self.execute_at, fallback_txn=self.partial_txn)
             else:
                 node.reply(from_node, reply_context, COMMIT_OK)
 
@@ -477,14 +477,16 @@ class ReadTxnData(TxnRequest):
         return MessageType.READ_REQ
 
     def process(self, node: "Node", from_node: int, reply_context) -> None:
-        execute_read(node, from_node, reply_context, self.txn_id, self.scope, None)
+        execute_read(node, from_node, reply_context, self.txn_id, self.scope,
+                     None)
 
     def __repr__(self):
         return f"ReadTxnData({self.txn_id!r})"
 
 
 def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
-                 scope: Route, execute_at_hint: Optional[Timestamp]) -> None:
+                 scope: Route, execute_at_hint: Optional[Timestamp],
+                 fallback_txn=None) -> None:
     """Wait per-store for ReadyToExecute, run the read, merge Data, reply ReadOk
     (ReadData.java:57-260 state machine, collapsed to the wait->execute->reply path)."""
     exec_epoch = execute_at_hint.epoch if execute_at_hint is not None else txn_id.epoch
@@ -502,7 +504,8 @@ def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
         node.reply(from_node, reply_context, ReadNack("unavailable"))
         return
 
-    chains = [store.submit(lambda s: _read_when_ready(s, txn_id)).flat_map(lambda c: c)
+    chains = [store.submit(
+        lambda s: _read_when_ready(s, txn_id, fallback_txn)).flat_map(lambda c: c)
               for store in stores]
 
     def consume(datas, failure):
@@ -536,7 +539,64 @@ def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
     au.all_of(chains).begin(consume)
 
 
-def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncChain:
+def _serve_read(s: SafeCommandStore, command, result, fallback_txn) -> bool:
+    """Serve the executeAt snapshot from this store: read the CLEAN slice and
+    report pending-bootstrap / stale (heal in flight) ranges as unavailable so
+    the coordinator can assemble full coverage across replicas (partial reads;
+    ReadData unavailable semantics + ReadCoordinator).  Refusing whole reads
+    on ANY overlap deadlocked chaos+churn burns cluster-wide.
+
+    ``fallback_txn``: truncated copies have their partial_txn stripped — the
+    fused Stable+Read request carries the definition, so the read still runs.
+    """
+    ptxn = command.partial_txn if command.partial_txn is not None else fallback_txn
+    if ptxn is None:
+        result.set_success("obsolete")   # no definition to read with
+        return True
+    # read against the ranges owned at the EXECUTION epoch (they may have
+    # been dropped in a later one; the data is still here)
+    ranges = s.store.ranges_at(command.execute_at.epoch) \
+        if command.execute_at is not None else s.store.current_ranges()
+    pending = s.store.pending_bootstrap
+    stale = getattr(s.data_store(), "stale_ranges", None)
+    if stale is not None and len(stale):
+        pending = pending.union(stale) if pending else stale
+    unavailable = Ranges.EMPTY
+    if pending:
+        k = ptxn.keys
+        if isinstance(k, Ranges):
+            unavailable = k.intersection(ranges).intersection(pending)
+        else:
+            hit = [rk for rk in (
+                key.to_routing() if hasattr(key, "to_routing") else key
+                for key in k)
+                if ranges.contains(rk) and pending.contains(rk)]
+            if hit:
+                unavailable = ranges.intersection(pending)
+        if len(unavailable):
+            ranges = ranges.without(pending)
+    read_keys = ptxn.keys.intersection(ranges) \
+        if isinstance(ptxn.keys, Ranges) \
+        else [k for k in ptxn.keys
+              if ranges.contains(k.to_routing() if hasattr(k, "to_routing") else k)]
+
+    def done(data, f, unavailable=unavailable):
+        if f is not None:
+            result.set_failure(f)
+        elif isinstance(data, str):
+            # sentinel ("obsolete"): the store cannot serve this read
+            result.set_success(data)
+        elif len(unavailable):
+            result.set_success(("partial", data, unavailable))
+        else:
+            result.set_success(data)
+
+    ptxn.read_chain(s, command.execute_at, read_keys).begin(done)
+    return True
+
+
+def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId,
+                     fallback_txn=None) -> au.AsyncChain:
     """Returns a chain yielding the Data read at executeAt (or 'nack')."""
     result = au.settable()
     store = safe_store.store
@@ -557,54 +617,7 @@ def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId) -> au.AsyncCha
             result.set_success("obsolete")
             return True
         if command.save_status is SaveStatus.READY_TO_EXECUTE:
-            # read against the ranges owned at the EXECUTION epoch (they may
-            # have been dropped in a later one; the data is still here)
-            ranges = s.store.ranges_at(command.execute_at.epoch) \
-                if command.execute_at is not None else s.store.current_ranges()
-            # bootstrap in progress: data for the PENDING ranges is incomplete
-            # here (deps on them may be bootstrap-elided; their writes arrive
-            # only with the fetch) — serve the CLEAN slice and report the
-            # pending remainder as unavailable so the coordinator can assemble
-            # full coverage across replicas (partial reads; ReadData
-            # unavailable semantics + ReadCoordinator).  Refusing whole reads
-            # on ANY overlap deadlocked chaos+churn burns cluster-wide: wide
-            # range reads always overlapped SOME pending range at every
-            # replica, while the bootstrap fences waited on the very txns
-            # whose reads were being refused.
-            pending = s.store.pending_bootstrap
-            unavailable = Ranges.EMPTY
-            if command.partial_txn is not None and pending:
-                k = command.partial_txn.keys
-                if isinstance(k, Ranges):
-                    unavailable = k.intersection(ranges).intersection(pending)
-                else:
-                    hit = [rk for rk in (
-                        key.to_routing() if hasattr(key, "to_routing") else key
-                        for key in k)
-                        if ranges.contains(rk) and pending.contains(rk)]
-                    if hit:
-                        unavailable = ranges.intersection(pending)
-                if len(unavailable):
-                    ranges = ranges.without(pending)
-            read_keys = command.partial_txn.keys.intersection(ranges) \
-                if isinstance(command.partial_txn.keys, Ranges) \
-                else [k for k in command.partial_txn.keys
-                      if ranges.contains(k.to_routing() if hasattr(k, "to_routing") else k)]
-
-            def done(data, f, unavailable=unavailable):
-                if f is not None:
-                    result.set_failure(f)
-                elif isinstance(data, str):
-                    # sentinel ("obsolete"): the store cannot serve this read
-                    result.set_success(data)
-                elif len(unavailable):
-                    result.set_success(("partial", data, unavailable))
-                else:
-                    result.set_success(data)
-
-            command.partial_txn.read_chain(s, command.execute_at, read_keys) \
-                .begin(done)
-            return True
+            return _serve_read(s, command, result, fallback_txn)
         return False
 
     command = safe_store.get_or_create(txn_id)
