@@ -60,10 +60,19 @@ class ListStore(DataStore):
     def get(self, key: Key) -> Tuple[object, ...]:
         return tuple(v for _, v in self.data.get(key, ()))
 
-    def get_at(self, key: Key, execute_at: Timestamp) -> Tuple[object, ...]:
+    def get_at(self, key: Key, execute_at: Timestamp,
+               exclusive: bool = False) -> Tuple[object, ...]:
         """Snapshot read: entries applied at-or-before ``execute_at`` only.
         Keeps reads correct even when a write with a LATER executeAt landed
-        early (truncated-outcome adoption applies out of dependency order)."""
+        early (truncated-outcome adoption applies out of dependency order).
+
+        ``exclusive`` drops the entry at exactly ``execute_at``: executeAts
+        are unique per txn, so when a read is served from a copy that already
+        APPLIED the txn, the exclusive bound removes exactly the txn's OWN
+        write — reconstructing the pre-apply snapshot the read semantics
+        require."""
+        if exclusive:
+            return tuple(v for ts, v in self.data.get(key, ()) if ts < execute_at)
         return tuple(v for ts, v in self.data.get(key, ()) if ts <= execute_at)
 
     def append(self, key: Key, execute_at: Timestamp, value: object) -> None:

@@ -66,7 +66,7 @@ class Command:
         "route", "partial_txn", "partial_deps",
         "promised", "accepted_or_committed",
         "execute_at", "execute_at_least", "writes", "result",
-        "waiting_on", "listeners",
+        "waiting_on", "listeners", "applied_locally",
     )
 
     def __init__(self, txn_id: TxnId):
@@ -92,6 +92,13 @@ class Command:
         self.waiting_on: Optional[WaitingOn] = None
         # commands locally waiting on us (by TxnId) — notified on status change
         self.listeners: Set[TxnId] = set()
+        # True iff the DEPENDENCY-ORDERED apply path ran here (_apply_writes):
+        # every dep's write is then locally present.  Truncated-with-outcome
+        # copies that adopted/landed writes out of order stay False — serving
+        # a read from them requires their gap to be stale-fenced.  Defaults
+        # False on journal reconstruction (conservative: reads refuse rather
+        # than risk a torn snapshot).
+        self.applied_locally: bool = False
 
     # -- status queries -----------------------------------------------------
     @property

@@ -87,6 +87,17 @@ class CommandStore:
         # max bounds it was last evaluated under; re-fault only on advance
         self.cold_gc_seen: dict = {}
         self.cache_miss_loads = 0
+        # async pending-load plane: count of declared-cold ids loaded via the
+        # PreLoadContext path (vs synchronous undeclared fault-ins)
+        self.pending_loads = 0
+        # diagnostic: local apply-order inversions recorded by the per-key
+        # timestamp registers (legal under MVCC; see timestamps_for_key.py)
+        self.tfk_inversions = 0
+        # per-key execution-timestamp registers (impl/TimestampsForKey.java):
+        # last_write / last_executed / monotonic HLC, updated on the normal
+        # execution path and merged on adoption/heal paths
+        from .timestamps_for_key import TimestampsForKeys
+        self.timestamps_for_key = TimestampsForKeys()
         # the conflict-index data plane (impl/resolver.py): answers the deps
         # and max-conflict queries; cpu = cfk walk, tpu = device GraphState
         from ..impl.resolver import make_resolver
@@ -147,14 +158,62 @@ class CommandStore:
                 CommandStore._current = prev
         self.executor.execute(run)
 
-    def submit(self, task: Callable[["SafeCommandStore"], object]) -> au.AsyncChain:
+    def submit(self, task: Callable[["SafeCommandStore"], object],
+               preload=None) -> au.AsyncChain:
         def run():
             prev, CommandStore._current = CommandStore._current, self
             try:
                 return task(SafeCommandStore(self))
             finally:
                 CommandStore._current = prev
-        return self.executor.submit(run)
+        pending = self._cold_among(preload)
+        if not pending:
+            return self.executor.submit(run)
+        result = au.settable()
+
+        def start():
+            self.executor.submit(run).begin(
+                lambda v, f: result.set_failure(f) if f is not None
+                else result.set_success(v))
+        self._load_then(pending, start)
+        return result.to_chain()
+
+    def _cold_among(self, preload) -> list:
+        """The declared ids whose state is evicted (PreLoadContext: the
+        operation cannot run until these are loaded)."""
+        if preload is None or not self.cold:
+            return []
+        return [tid for tid in preload if tid in self.cold]
+
+    def _load_then(self, pending: list, start: Callable[[], None]) -> None:
+        """The pending-load path (PreLoadContext.java /
+        AbstractSafeCommandStore's load machinery): each declared-cold id is
+        faulted in by a SEPARATE executor task before the operation task is
+        scheduled.  Under DelayedAgentExecutor every hop gets a random delay,
+        so other store tasks interleave with the load — the interleaving the
+        reference's cache-miss injection exists to stress
+        (DelayedCommandStores.java:138-195)."""
+        self.pending_loads += len(pending)
+
+        def load_one(i: int):
+            def run_load():
+                prev, CommandStore._current = CommandStore._current, self
+                try:
+                    if pending[i] in self.cold:
+                        self._fault_in(pending[i])
+                except BaseException as e:  # noqa: BLE001
+                    # a failed load must not strand the operation (the chain
+                    # would never settle and the request would hang): report
+                    # and continue — the op sees the id as absent/recreated
+                    self.agent().on_uncaught_exception(e)
+                finally:
+                    CommandStore._current = prev
+                    if i + 1 < len(pending):
+                        load_one(i + 1)
+                    else:
+                        start()
+            self.executor.execute(run_load)
+        load_one(0)
 
     def check_in_store(self) -> None:
         Invariants.check_state(CommandStore._current is self,
@@ -475,6 +534,11 @@ class SafeCommandStore:
             bound = store.redundant_before.shard_redundant_before(rk)
             if bound is not None:
                 store.resolver.on_pruned(rk, cfk.prune_applied_before(bound))
+        # trim the per-key execution registers below the same bound
+        # (TimestampsForKey.withoutRedundant)
+        store.timestamps_for_key.remove_redundant_by(
+            lambda key: store.redundant_before.shard_redundant_before(
+                key.to_routing() if hasattr(key, "to_routing") else key))
         for txn_id in list(store.range_txns):
             rngs, _status = store.range_txns[txn_id]
             if store.redundant_before.is_locally_redundant(txn_id, rngs) \
@@ -560,12 +624,15 @@ class CommandStores:
 
     def map_reduce(self, unseekables, min_epoch: int, max_epoch: int,
                    map_fn: Callable[[SafeCommandStore], object],
-                   reduce_fn: Callable[[object, object], object]) -> au.AsyncChain:
-        """Run map_fn in every intersecting store (on its executor), reduce results."""
+                   reduce_fn: Callable[[object, object], object],
+                   preload=None) -> au.AsyncChain:
+        """Run map_fn in every intersecting store (on its executor), reduce
+        results.  ``preload`` declares the txn ids the operation touches
+        (PreLoadContext): evicted ones are loaded asynchronously first."""
         stores = self.intersecting_stores(unseekables, min_epoch, max_epoch)
         if not stores:
             return au.done(None)
-        chains = [s.submit(map_fn) for s in stores]
+        chains = [s.submit(map_fn, preload=preload) for s in stores]
 
         def reduce_all(results):
             acc = None
@@ -580,9 +647,11 @@ class CommandStores:
         return au.all_of(chains).map(reduce_all)
 
     def for_each(self, unseekables, min_epoch: int, max_epoch: int,
-                 fn: Callable[[SafeCommandStore], None]) -> au.AsyncChain:
+                 fn: Callable[[SafeCommandStore], None],
+                 preload=None) -> au.AsyncChain:
         return self.map_reduce(unseekables, min_epoch, max_epoch,
-                               lambda s: (fn(s), None)[1], lambda a, b: None)
+                               lambda s: (fn(s), None)[1], lambda a, b: None,
+                               preload=preload)
 
     def all_stores(self) -> List[CommandStore]:
         return list(self.stores)

@@ -260,6 +260,12 @@ def adopt_truncated_outcome(safe_store: SafeCommandStore, command: Command,
         if failure is not None:
             safe_store.agent().on_uncaught_exception(failure)
             return
+        # adoption lands writes out of dependency order: merge the per-key
+        # registers monotonically, no validation (the safeToReadAt-gated case)
+        if writes is not None and not writes.is_empty():
+            tfk = safe_store.store.timestamps_for_key
+            for key in writes.keys:
+                tfk.merge_applied_write(key, execute_at)
         command.partial_txn = None
         command.partial_deps = None
         command.waiting_on = None
@@ -532,7 +538,18 @@ def _apply_writes(safe_store: SafeCommandStore, command: Command) -> None:
         if failure is not None:
             safe_store.agent().on_uncaught_exception(failure)
             return
+        # per-key execution registers: the NORMAL (dependency-ordered) apply
+        # path validates write monotonicity (TimestampsForKeys.java:36-69)
+        if command.writes is not None and not command.writes.is_empty():
+            tfk = safe_store.store.timestamps_for_key
+            for key in command.writes.keys:
+                rk = key.to_routing() if hasattr(key, "to_routing") else key
+                if ranges.contains(rk):
+                    tfk.update_last_execution(safe_store, key,
+                                              command.execute_at, True,
+                                              txn_id=command.txn_id)
         command.set_save_status(SaveStatus.APPLIED)
+        command.applied_locally = True
         safe_store.journal_save(command)
         safe_store.register_witness(command, InternalStatus.APPLIED)
         # an applied exclusive sync point waited on everything before it on its
@@ -585,6 +602,9 @@ def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
                 # here, or an adopted outcome): land its OWN writes locally
                 # before anything else — no network needed for this txn's gap
                 command.writes.apply_to(safe_store, safe_store.store.all_ranges())
+                for key in command.writes.keys:
+                    safe_store.store.timestamps_for_key.merge_applied_write(
+                        key, command.execute_at)
             # predecessors may be missing too (that is WHY this txn never
             # applied): stale-mark + peer-snapshot heal over the footprint
             from ..messages.status_messages import _heal_store_gaps

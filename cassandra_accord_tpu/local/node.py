@@ -274,16 +274,19 @@ class Node(ConfigurationService.Listener):
     # -- local map/reduce over stores (Node.java:384-422) ---------------------
     def map_reduce_consume_local(self, unseekables, min_epoch: int, max_epoch: int,
                                  map_fn: Callable[[SafeCommandStore], object],
-                                 reduce_fn: Callable[[object, object], object]) -> au.AsyncChain:
+                                 reduce_fn: Callable[[object, object], object],
+                                 preload=None) -> au.AsyncChain:
         return self.command_stores.map_reduce(unseekables, min_epoch, max_epoch,
-                                              map_fn, reduce_fn)
+                                              map_fn, reduce_fn, preload=preload)
 
     def for_each_local(self, unseekables, min_epoch: int, max_epoch: int,
-                       fn: Callable[[SafeCommandStore], None]) -> au.AsyncResult:
+                       fn: Callable[[SafeCommandStore], None],
+                       preload=None) -> au.AsyncResult:
         """Run ``fn`` in every intersecting store.  EAGER (unlike map_reduce_
         consume_local): the chain is begun here — fire-and-forget callers
         (CommitInvalidate, Propagate, Inform*) must not silently no-op."""
-        chain = self.command_stores.for_each(unseekables, min_epoch, max_epoch, fn)
+        chain = self.command_stores.for_each(unseekables, min_epoch, max_epoch,
+                                             fn, preload=preload)
         result = au.settable()
 
         def on_done(_value, failure):

@@ -181,6 +181,13 @@ class TxnRequest(Request):
     def wait_for_epoch(self) -> int:
         return self._wait_for_epoch
 
+    def preload_ids(self):
+        """PreLoadContext declaration (PreLoadContext.java): the txn ids this
+        request's in-store processing touches.  Evicted ones are loaded
+        asynchronously BEFORE the operation task runs; subclasses whose
+        handlers walk dependencies (Commit, Apply) extend this."""
+        return (self.txn_id,)
+
     @staticmethod
     def compute_scope(to_node: int, topologies: "Topologies", route: Route) -> Optional[Route]:
         """Slice ``route`` to the ranges ``to_node`` replicates across the given

@@ -155,7 +155,15 @@ def _read_after_deps(safe_store: SafeCommandStore, txn_id: TxnId,
         read_keys = [key for key in partial_txn.keys
                      if local_ranges.contains(key.to_routing()
                                               if hasattr(key, "to_routing") else key)]
-        partial_txn.read_chain(s, txn_id.as_timestamp(), read_keys).begin(
+        # ephemeral reads are never witnessed: the per-key registers are the
+        # ONLY record that this key was snapshotted at this timestamp — a
+        # later write landing below it is a deps-completeness violation the
+        # registers alone can catch (impl/TimestampsForKey.java)
+        tfk = s.store.timestamps_for_key
+        snapshot_at = txn_id.as_timestamp()
+        for key in read_keys:
+            tfk.record_ephemeral_read(key, snapshot_at)
+        partial_txn.read_chain(s, snapshot_at, read_keys).begin(
             lambda data, f: result.set_failure(f) if f is not None
             else result.set_success(data))
 

@@ -352,7 +352,8 @@ class BeginRecovery(TxnRequest):
                 node.reply(from_node, reply_context, result)
 
         node.map_reduce_consume_local(scope, node.topology.min_epoch, txn_id.epoch,
-                                      map_fn, reduce_fn).begin(consume)
+                                      map_fn, reduce_fn,
+                                      preload=self.preload_ids()).begin(consume)
 
     def __repr__(self):
         return f"BeginRecovery({self.txn_id!r}, ballot={self.ballot!r})"
@@ -452,7 +453,8 @@ class AcceptInvalidate(TxnRequest):
                 node.reply(from_node, reply_context, result)
 
         node.map_reduce_consume_local(self.scope, node.topology.min_epoch, txn_id.epoch,
-                                      map_fn, reduce_fn).begin(consume)
+                                      map_fn, reduce_fn,
+                                      preload=self.preload_ids()).begin(consume)
 
     def __repr__(self):
         return f"AcceptInvalidate({self.txn_id!r}, ballot={self.ballot!r})"
@@ -471,7 +473,8 @@ class CommitInvalidate(TxnRequest):
         def for_store(safe_store: SafeCommandStore):
             C.commit_invalidate(safe_store, txn_id, scope=self.scope)
 
-        node.for_each_local(self.scope, node.topology.min_epoch, txn_id.epoch, for_store)
+        node.for_each_local(self.scope, node.topology.min_epoch, txn_id.epoch,
+                            for_store, preload=self.preload_ids())
 
     def __repr__(self):
         return f"CommitInvalidate({self.txn_id!r})"
@@ -531,7 +534,8 @@ class WaitOnCommit(TxnRequest):
                 safe_store.add_transient_listener(txn_id, listener)
             return result.to_chain()
 
-        chains = [store.submit(wait_in).flat_map(lambda c: c) for store in stores]
+        chains = [store.submit(wait_in, preload=(txn_id,))
+                  .flat_map(lambda c: c) for store in stores]
 
         def consume(_values, failure):
             if failure is not None:

@@ -221,7 +221,8 @@ class CheckStatus(TxnRequest):
                            result if result is not None else CheckStatusOk.empty(txn_id))
 
         node.map_reduce_consume_local(self.scope, txn_id.epoch, txn_id.epoch,
-                                      map_fn, lambda a, b: a.merge(b)).begin(consume)
+                                      map_fn, lambda a, b: a.merge(b),
+                                      preload=self.preload_ids()).begin(consume)
 
     def __repr__(self):
         return f"CheckStatus({self.txn_id!r})"
@@ -307,7 +308,8 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk):
         if status.has_been(Status.PRE_ACCEPTED) and merged.partial_txn is not None:
             C.preaccept(safe_store, txn_id, merged.partial_txn, route)
 
-    return node.for_each_local(route, txn_id.epoch, max_epoch, for_store)
+    return node.for_each_local(route, txn_id.epoch, max_epoch, for_store,
+                               preload=(txn_id,))
 
 
 def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
@@ -441,7 +443,8 @@ class InformOfTxn(TxnRequest):
             progress_shard = safe_store.current_ranges().contains(scope.home_key)
             safe_store.progress_log().unwitnessed(txn_id, scope.home_key, progress_shard)
 
-        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store)
+        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store,
+                            preload=(txn_id,))
 
     def __repr__(self):
         return f"InformOfTxn({self.txn_id!r})"
@@ -583,7 +586,8 @@ class InformDurable(TxnRequest):
         def for_store(safe_store: SafeCommandStore) -> None:
             C.set_durability(safe_store, txn_id, durability, scope, execute_at)
 
-        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store)
+        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store,
+                            preload=(txn_id,))
 
     def __repr__(self):
         return f"InformDurable({self.txn_id!r}, {self.durability.name})"
@@ -620,7 +624,8 @@ class InformHomeDurable(TxnRequest):
             # variant stalled hostile burns to the probe cap.
             C.set_durability(safe_store, txn_id, durability, scope, execute_at)
 
-        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store)
+        node.for_each_local(scope, txn_id.epoch, txn_id.epoch, for_store,
+                            preload=(txn_id,))
 
     def __repr__(self):
         return f"InformHomeDurable({self.txn_id!r}, {self.durability.name})"

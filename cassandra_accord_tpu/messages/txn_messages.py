@@ -313,7 +313,8 @@ class PreAccept(TxnRequest):
                 node.reply(from_node, reply_context, PreAcceptOk(txn_id, witnessed_at, deps))
 
         node.map_reduce_consume_local(scope, node.topology.min_epoch, self.max_epoch,
-                                      map_fn, reduce_fn).begin(consume)
+                                      map_fn, reduce_fn,
+                                      preload=self.preload_ids()).begin(consume)
 
     def prefetch_specs(self, node):
         # mirrors the handler's two consults: max_conflict over ALL the txn's
@@ -390,7 +391,8 @@ class Accept(TxnRequest):
                 node.reply(from_node, reply_context, AcceptOk(txn_id, result[1]))
 
         node.map_reduce_consume_local(scope, node.topology.min_epoch,
-                                      execute_at.epoch, map_fn, reduce_fn).begin(consume)
+                                      execute_at.epoch, map_fn, reduce_fn,
+                                      preload=self.preload_ids()).begin(consume)
 
     def prefetch_specs(self, node):
         # the Accept deps walk runs AFTER the self-registration, whose effect
@@ -425,6 +427,13 @@ class Commit(TxnRequest):
         self.read = read
         self.route = route if route is not None else scope
 
+    def preload_ids(self):
+        # commit walks its deps to initialise WaitingOn (PreLoadContext
+        # .contextFor(txnId, deps) in the reference's Commit handler)
+        if self.partial_deps is None:
+            return (self.txn_id,)
+        return (self.txn_id, *self.partial_deps.txn_ids())
+
     @property
     def type(self):
         return MessageType.STABLE_FAST_PATH_REQ if self.kind_status is SaveStatus.STABLE \
@@ -453,7 +462,8 @@ class Commit(TxnRequest):
 
         node.map_reduce_consume_local(self.scope, node.topology.min_epoch,
                                       self.execute_at.epoch,
-                                      map_fn, worst_outcome).begin(consume)
+                                      map_fn, worst_outcome,
+                                      preload=self.preload_ids()).begin(consume)
 
     def __repr__(self):
         tag = "+read" if self.read else ""
@@ -505,7 +515,8 @@ def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
         return
 
     chains = [store.submit(
-        lambda s: _read_when_ready(s, txn_id, fallback_txn)).flat_map(lambda c: c)
+        lambda s: _read_when_ready(s, txn_id, fallback_txn),
+        preload=(txn_id,)).flat_map(lambda c: c)
               for store in stores]
 
     def consume(datas, failure):
@@ -539,7 +550,26 @@ def execute_read(node: "Node", from_node: int, reply_context, txn_id: TxnId,
     au.all_of(chains).begin(consume)
 
 
-def _serve_read(s: SafeCommandStore, command, result, fallback_txn) -> bool:
+class _ExclusiveSnapshotView:
+    """DataStore view whose ``get_at`` excludes the entry at exactly
+    ``execute_at`` — used when serving a read from a copy that already
+    APPLIED the txn, where the store contains the txn's OWN write at
+    ts == execute_at (executeAts are unique, so the exclusive bound strips
+    exactly that entry and nothing else)."""
+    __slots__ = ("_ds",)
+
+    def __init__(self, ds):
+        self._ds = ds
+
+    def __getattr__(self, name):
+        return getattr(self._ds, name)
+
+    def get_at(self, key, execute_at):
+        return self._ds.get_at(key, execute_at, exclusive=True)
+
+
+def _serve_read(s: SafeCommandStore, command, result, fallback_txn,
+                applied: bool = False) -> bool:
     """Serve the executeAt snapshot from this store: read the CLEAN slice and
     report pending-bootstrap / stale (heal in flight) ranges as unavailable so
     the coordinator can assemble full coverage across replicas (partial reads;
@@ -591,7 +621,21 @@ def _serve_read(s: SafeCommandStore, command, result, fallback_txn) -> bool:
         else:
             result.set_success(data)
 
-    ptxn.read_chain(s, command.execute_at, read_keys).begin(done)
+    ds = _ExclusiveSnapshotView(s.data_store()) if applied else None
+    if not applied and command.execute_at is not None \
+            and not isinstance(read_keys, Ranges):
+        # normal-path committed read: advance the per-key execution registers
+        # for the keys the Read DECLARES (read_keys here is the full txn
+        # footprint; write-only keys are registered by _apply_writes).
+        # Validated; historical applied-copy serves skip this — their
+        # snapshot is below the store's execution frontier by design.
+        declared = ptxn.read.keys() if ptxn.read is not None else None
+        tfk = s.store.timestamps_for_key
+        for key in read_keys:
+            if declared is None or isinstance(declared, Ranges) \
+                    or declared.contains(key):
+                tfk.update_last_execution(s, key, command.execute_at, False)
+    ptxn.read_chain(s, command.execute_at, read_keys, data_store=ds).begin(done)
     return True
 
 
@@ -605,19 +649,54 @@ def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId,
         if command.save_status is SaveStatus.INVALIDATED:
             result.set_success("nack")
             return True
-        if command.save_status.ordinal > SaveStatus.READY_TO_EXECUTE.ordinal \
-                or command.save_status.is_truncated:
-            # the command raced past ReadyToExecute here (an Apply — possibly a
-            # recovery's Maximal — or truncation won): the executeAt snapshot
-            # can no longer be served from this replica, and crucially its
-            # dependencies may NOT all be locally applied yet (PreApplied means
-            # waiting-to-apply).  Reading now would return torn state; report
-            # obsolete so the coordinator reads elsewhere
-            # (ReadData.java:57-260 State/Action obsolescence machine)
+        def _gap_fenced(s: SafeCommandStore, cmd) -> bool:
+            """A TRUNCATED_APPLY copy that never ran the dependency-ordered
+            apply may be missing predecessor writes.  Serving it is sound
+            only when the possibly-gappy footprint is stale/bootstrap-fenced
+            (then _serve_read reports those slices unavailable).  The fencing
+            paths have escape hatches — lone-replica heal, route-less
+            truncation — where nothing was fenced: refuse there."""
+            if cmd.route is None:
+                return False
+            parts = cmd.route.participants().slice(s.current_ranges())
+            if not len(parts):
+                return True   # nothing of the footprint lives here
+            fenced = s.store.pending_bootstrap or Ranges.EMPTY
+            stale = getattr(s.data_store(), "stale_ranges", None)
+            if stale is not None and len(stale):
+                fenced = fenced.union(stale)
+            if isinstance(parts, Ranges):
+                return not len(parts.without(fenced))
+            return all(fenced.contains(p) for p in parts)
+
+        if (command.save_status is SaveStatus.APPLIED
+            or (command.save_status is SaveStatus.TRUNCATED_APPLY
+                and (command.applied_locally or _gap_fenced(s, command)))) \
+                and command.execute_at is not None:
+            # the command raced past ReadyToExecute here (an Apply — possibly
+            # a recovery's Maximal — or a with-outcome truncation won).  The
+            # store is a timestamped MVCC snapshot, so unlike the reference
+            # (ReadData.java:57-260 nacks obsolete — Cassandra's store has no
+            # per-executeAt snapshot) the read CAN still be served: APPLIED
+            # means every dependency's write landed locally, any known data
+            # gap (truncated-without-local-apply) is stale-fenced and reported
+            # as unavailable slices by _serve_read, and the EXCLUSIVE snapshot
+            # bound strips the txn's own write at ts == executeAt.  Without
+            # this, sustained-chaos recoveries livelock: every replica's copy
+            # races to APPLIED before the recovery's read round arrives and
+            # the read phase exhausts on obsolete nacks (seed-4 churn stall).
+            return _serve_read(s, command, result, fallback_txn, applied=True)
+        if command.save_status.is_truncated:
+            # ERASED (no executeAt left to snapshot at): genuinely obsolete —
+            # the coordinator reads elsewhere; stale-marking covers any gap
             result.set_success("obsolete")
             return True
         if command.save_status is SaveStatus.READY_TO_EXECUTE:
             return _serve_read(s, command, result, fallback_txn)
+        # PRE_APPLIED / APPLYING: deps not yet locally applied — the snapshot
+        # below executeAt is incomplete.  Keep waiting: apply completes
+        # locally (WaitingOn drain / progress-log recovery of deps) and the
+        # listener re-fires at APPLIED, where the read serves exclusively.
         return False
 
     command = safe_store.get_or_create(txn_id)
@@ -653,6 +732,11 @@ class Apply(TxnRequest):
         self.result = result
         self.route = route if route is not None else scope
 
+    def preload_ids(self):
+        if self.partial_deps is None:
+            return (self.txn_id,)
+        return (self.txn_id, *self.partial_deps.txn_ids())
+
     @property
     def type(self):
         return MessageType.APPLY_MAXIMAL_REQ if self.kind == Apply.MAXIMAL \
@@ -678,7 +762,8 @@ class Apply(TxnRequest):
 
         node.map_reduce_consume_local(self.scope, node.topology.min_epoch,
                                       self.execute_at.epoch,
-                                      map_fn, worst_outcome).begin(consume)
+                                      map_fn, worst_outcome,
+                                      preload=self.preload_ids()).begin(consume)
 
     def __repr__(self):
         return f"Apply[{self.kind}]({self.txn_id!r})"
@@ -723,7 +808,8 @@ class ApplyThenWaitUntilApplied(Apply):
 
         node.map_reduce_consume_local(self.scope, node.topology.min_epoch,
                                       self.execute_at.epoch,
-                                      map_fn, worst_outcome).begin(consume)
+                                      map_fn, worst_outcome,
+                                      preload=self.preload_ids()).begin(consume)
 
     def __repr__(self):
         return f"ApplyThenWaitUntilApplied({self.txn_id!r})"
@@ -777,7 +863,8 @@ def await_applied_local(node: "Node", txn_id: TxnId, unseekables,
                                                      max_epoch)
     if not stores:
         return au.done("ok")
-    chains = [store.submit(lambda s: await_applied(s, txn_id))
+    chains = [store.submit(lambda s: await_applied(s, txn_id),
+                           preload=(txn_id,))
               .flat_map(lambda c: c) for store in stores]
     return au.all_of(chains).map(
         lambda results: "nack" if any(r == "nack" for r in results) else "ok")

@@ -92,10 +92,15 @@ class Txn:
             else self.keys.intersects(ranges)
 
     # -- execution helpers (Txn.java:395-422) --------------------------------
-    def read_chain(self, safe_store, execute_at: Timestamp, read_scope) -> "au.AsyncChain":
-        """Execute the read hook for every key in scope; merge Data."""
+    def read_chain(self, safe_store, execute_at: Timestamp, read_scope,
+                   data_store=None) -> "au.AsyncChain":
+        """Execute the read hook for every key in scope; merge Data.
+
+        ``data_store`` overrides the store view (e.g. an exclusive-snapshot
+        wrapper when serving a read from an already-applied copy)."""
         chains = []
-        data_store = safe_store.data_store()
+        if data_store is None:
+            data_store = safe_store.data_store()
         read_keys = self.read.keys()
         for key in read_scope:
             if read_keys is not None and not isinstance(read_keys, Ranges) \

@@ -1,0 +1,143 @@
+"""TimestampsForKey register semantics (impl/TimestampsForKey.java parity)."""
+import pytest
+
+from cassandra_accord_tpu.local.timestamps_for_key import (TimestampsForKey,
+                                                           TimestampsForKeys)
+from cassandra_accord_tpu.primitives.timestamp import (Domain, Timestamp,
+                                                       TxnId, TxnKind)
+
+
+def ts(hlc, node=1, epoch=1):
+    return Timestamp(epoch=epoch, hlc=hlc, node=node)
+
+
+class TestRegisters:
+    def test_write_advances_all(self):
+        tfk = TimestampsForKey("k")
+        assert tfk.record_execution(ts(10), True) is False
+        assert tfk.last_write == ts(10)
+        assert tfk.last_executed == ts(10)
+        assert tfk.last_executed_hlc == 10
+
+    def test_read_advances_executed_not_write(self):
+        tfk = TimestampsForKey("k")
+        tfk.record_execution(ts(10), True)
+        assert tfk.record_execution(ts(20), False) is False
+        assert tfk.last_write == ts(10)
+        assert tfk.last_executed == ts(20)
+
+    def test_write_below_last_write_counts_inversion(self):
+        # local apply-order inversion: absorbed by the MVCC store, recorded
+        # as a diagnostic (module doc rationale)
+        tfk = TimestampsForKey("k")
+        tfk.record_execution(ts(10), True)
+        assert tfk.record_execution(ts(5), True) is True
+        assert tfk.last_write == ts(10)   # no regression
+
+    def test_read_below_registers_is_legal(self):
+        tfk = TimestampsForKey("k")
+        tfk.record_execution(ts(10), True)
+        assert tfk.record_execution(ts(5), False) is False
+        assert tfk.last_executed == ts(10)
+
+    def test_equal_execute_at_is_idempotent(self):
+        tfk = TimestampsForKey("k")
+        tfk.record_execution(ts(10), True)
+        hlc = tfk.last_executed_hlc
+        assert tfk.record_execution(ts(10), True) is False
+        assert tfk.last_executed_hlc == hlc
+
+    def test_hlc_strictly_monotonic_on_ties(self):
+        # two executions whose executeAt HLCs tie (different node ids) must
+        # still produce strictly increasing register HLCs
+        tfk = TimestampsForKey("k")
+        tfk.record_execution(ts(10, node=1), True)
+        tfk.record_execution(ts(10, node=2), True)
+        assert tfk.last_executed_hlc == 11
+
+    def test_ephemeral_fence(self):
+        tfk = TimestampsForKey("k")
+        tfk.record_ephemeral_read(ts(15))
+        assert tfk.last_ephemeral_read == ts(15)
+        assert tfk.last_executed == ts(15)
+        # a write below the served snapshot missed it: the enforced invariant
+        assert tfk.violates_ephemeral_fence(ts(10), True)
+        assert not tfk.violates_ephemeral_fence(ts(20), True)
+        assert not tfk.violates_ephemeral_fence(ts(10), False)
+
+    def test_without_redundant(self):
+        tfk = TimestampsForKey("k")
+        tfk.record_execution(ts(10), True)
+        tfk.record_ephemeral_read(ts(12))
+        assert not tfk.without_redundant(ts(5))
+        assert tfk.last_write == ts(10)
+        assert tfk.without_redundant(ts(50))
+        assert tfk.last_write is None and tfk.last_executed is None
+        assert tfk.last_ephemeral_read is None
+
+
+class TestRegistry:
+    def test_get_or_create_and_gc(self):
+        reg = TimestampsForKeys()
+        reg.merge_applied_write("a", ts(10))
+        reg.merge_applied_write("b", ts(100))
+        assert len(reg) == 2
+        reg.remove_redundant(ts(50))
+        assert len(reg) == 1
+        assert reg.get_if_present("a") is None
+        assert reg.get_if_present("b").last_write == ts(100)
+
+
+class TestClusterConsistency:
+    """The registers on a live cluster: after quiescence every key's
+    last_write equals the max executeAt among writes applied to it, and an
+    ephemeral read advances last_executed but not last_write."""
+
+    def _cluster(self):
+        from cassandra_accord_tpu.harness.cluster import Cluster
+        from cassandra_accord_tpu.primitives.keys import IntKey, Range
+        from cassandra_accord_tpu.topology.topology import Shard, Topology
+        return Cluster(Topology(
+            1, [Shard(Range(IntKey(0), IntKey(1000)), [1, 2, 3])]), seed=5)
+
+    def test_registers_match_data_plane(self):
+        from cassandra_accord_tpu.impl.list_store import list_txn
+        from cassandra_accord_tpu.primitives.keys import IntKey
+        cluster = self._cluster()
+        results = [cluster.nodes[1 + (i % 3)].coordinate(
+            list_txn([IntKey(7)], {IntKey(7): f"v{i}"})) for i in range(6)]
+        assert cluster.run_until(lambda: all(r.is_done() for r in results))
+        cluster.run_until_idle()
+        for n, node in cluster.nodes.items():
+            entries = node.data_store.data.get(IntKey(7), ())
+            assert entries
+            max_ts = max(e[0] for e in entries)
+            for cs in node.command_stores.all_stores():
+                tfk = cs.timestamps_for_key.get_if_present(IntKey(7))
+                if tfk is not None and tfk.last_write is not None:
+                    assert tfk.last_write == max_ts, \
+                        f"node {n}: register {tfk.last_write} != data {max_ts}"
+
+    def test_ephemeral_read_advances_registers(self):
+        from cassandra_accord_tpu.impl.list_store import (ephemeral_read_txn,
+                                                          list_txn)
+        from cassandra_accord_tpu.primitives.keys import IntKey
+        cluster = self._cluster()
+        w = cluster.nodes[1].coordinate(list_txn([], {IntKey(5): "a"}))
+        assert cluster.run_until(w.is_done)
+        cluster.run_until_idle()
+        r = cluster.nodes[2].coordinate(ephemeral_read_txn([IntKey(5)]))
+        assert cluster.run_until(r.is_done)
+        cluster.run_until_idle()
+        advanced = False
+        for node in cluster.nodes.values():
+            for cs in node.command_stores.all_stores():
+                tfk = cs.timestamps_for_key.get_if_present(IntKey(5))
+                if tfk is None or tfk.last_executed is None:
+                    continue
+                assert tfk.last_write is None or \
+                    tfk.last_executed >= tfk.last_write
+                if tfk.last_write is not None \
+                        and tfk.last_executed > tfk.last_write:
+                    advanced = True   # the read moved last_executed past it
+        assert advanced
