@@ -125,6 +125,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              durability: bool = False,
              batch_window_us: int = 0,
              cache_miss: bool = False,
+             frontier_exec: bool = False,
              max_tasks: int = 20_000_000,
              tracer=None, on_submit=None, consult_recorder=None) -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation.
@@ -224,6 +225,44 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         # continuous frontier parity at (deterministic) quiescent task points
         frontier_task = cluster.scheduler.recurring(
             0.7, lambda: verify_frontiers(cluster))
+    frontier_release_task = None
+    if frontier_exec:
+        # frontier-DRIVEN execution (SURVEY §7 stage 8): indexed STABLE txns
+        # whose WaitingOn drained park in store.exec_deferred; only the device
+        # kahn_frontier releases them into ReadyToExecute.  A frontier that
+        # misses a ready txn stalls the burn — the parity failure is loud.
+        assert resolver in ("verify", "tpu"), \
+            "frontier_exec needs the device resolver's wait-graph mirror"
+        from ..local import commands as C
+        from ..local.status import SaveStatus as _SS
+        for node in cluster.nodes.values():
+            for cs in node.command_stores.all_stores():
+                cs.frontier_exec = True
+
+        def release_frontiers():
+            for node in cluster.nodes.values():
+                for cs in node.command_stores.all_stores():
+                    if not cs.exec_deferred:
+                        continue
+
+                    def in_store(safe, cs=cs):
+                        if not cs.exec_deferred:
+                            return
+                        r = getattr(cs.resolver, "tpu", cs.resolver)
+                        ready = r.frontier_ready()
+                        for tid in list(cs.exec_deferred):
+                            cmd = safe.get_if_exists(tid)
+                            if cmd is None \
+                                    or cmd.save_status is not _SS.STABLE:
+                                cs.exec_deferred.discard(tid)
+                                continue
+                            if tid in ready:
+                                cs.exec_deferred.discard(tid)
+                                C.maybe_execute(safe, cmd, True,
+                                                from_frontier=True)
+                    cs.execute(in_store)
+        frontier_release_task = cluster.scheduler.recurring(
+            0.05, release_frontiers)
     verifier = StrictSerializabilityVerifier()
     result = BurnResult(seed)
     zipf = rng.next_boolean()
@@ -363,6 +402,29 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         if frontier_task is not None:
             frontier_task.cancel()
             verify_frontiers(cluster)   # final quiescent frontier parity
+        elif resolver == "verify":
+            # chaos / delayed-store runs: mid-run points are nondeterministic,
+            # but FINAL quiescence must still agree (VERDICT r03 item 3)
+            verify_frontiers(cluster)
+        if frontier_release_task is not None:
+            frontier_release_task.cancel()
+            # txns parked AFTER the last release tick (run_until_idle stops
+            # once only recurring tasks remain) are not frontier misses: keep
+            # releasing until the deferred sets stop draining, THEN judge
+            for _ in range(8):
+                if not any(cs.exec_deferred
+                           for n in cluster.nodes.values()
+                           for cs in n.command_stores.all_stores()):
+                    break
+                release_frontiers()
+                cluster.run_until_idle(max_tasks=max_tasks)
+            leftover = [(n.id, cs.id, sorted(cs.exec_deferred))
+                        for n in cluster.nodes.values()
+                        for cs in n.command_stores.all_stores()
+                        if cs.exec_deferred]
+            if leftover:
+                raise HistoryViolation(
+                    f"frontier-driven execution left deferred txns: {leftover}")
         result.ops_submitted = state["submitted"]
         result.sim_micros = cluster.now_micros
         result.stats = dict(cluster.stats)

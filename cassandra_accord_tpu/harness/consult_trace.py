@@ -132,6 +132,12 @@ class _RecordingResolver(DepsResolver):
         self.inner.end_batch()
 
     # -- frontier mirror (not replayed; passthrough) --------------------------
+    def is_indexed(self, txn_id) -> bool:
+        # explicit delegation: the base class defines this (returns False),
+        # so __getattr__ would never forward it — frontier_exec under a
+        # recorder would silently degrade to inline execution
+        return self.inner.is_indexed(txn_id)
+
     def register_waiting(self, waiter, deps) -> None:
         self.inner.register_waiting(waiter, deps)
 

@@ -87,33 +87,46 @@ class ListStore(DataStore):
         """All stored keys within a Range, sorted (range-read enumeration)."""
         return sorted(k for k in self.data if rng.contains(k.to_routing()))
 
-    def fetch(self, node, safe_store, ranges, sync_point, fetch_ranges):
-        """Pull ``ranges``' contents from a prior-epoch replica (bootstrap
+    def fetch(self, node, safe_store, ranges, sync_point, fetch_ranges,
+              catch_up: bool = False):
+        """Pull ``ranges``' contents from a source replica (bootstrap
         streaming; impl/AbstractFetchCoordinator.java).  Sources have applied
         the fencing sync point, so their data is complete up to it; entries are
-        timestamped so concurrent Apply traffic composes idempotently."""
+        timestamped so concurrent Apply traffic composes idempotently.
+
+        ``catch_up=False`` (topology-change adoption): sources are the
+        PRIOR-epoch shard replicas — they held the data before the move; no
+        prior topology means fresh key-space (trivially complete).
+        ``catch_up=True`` (stale-range bootstrap-grade heal): this store
+        already owns the ranges and is refetching IN PLACE — sources are the
+        fence-epoch shard PEERS, and a slice with no reachable peer fails the
+        attempt (never 'trivially complete': the data exists, we lost it)."""
         from ..messages.base import Callback
         from ..messages.fetch_messages import FetchStoreData, FetchStoreDataOk
 
-        # fetch plan: per prior-epoch SHARD slice, from that shard's replicas —
-        # a single source need not cover all the ranges (they may span shards
-        # with disjoint replica sets)
+        # fetch plan: per source-topology SHARD slice, from that shard's
+        # replicas — a single source need not cover all the ranges (they may
+        # span shards with disjoint replica sets)
         epoch = sync_point.txn_id.epoch
-        prior = None
-        for e in range(epoch - 1, node.topology.min_epoch - 1, -1):
-            if node.topology.has_epoch(e):
-                prior = node.topology.topology_for_epoch(e)
-                break
+        source_topo = None
+        if catch_up:
+            if node.topology.has_epoch(epoch):
+                source_topo = node.topology.topology_for_epoch(epoch)
+        else:
+            for e in range(epoch - 1, node.topology.min_epoch - 1, -1):
+                if node.topology.has_epoch(e):
+                    source_topo = node.topology.topology_for_epoch(e)
+                    break
         plan = []   # (sub_ranges, [candidate sources])
-        if prior is not None:
-            for shard in prior.shards:
+        if source_topo is not None:
+            for shard in source_topo.shards:
                 sub = ranges.intersection(Ranges.of(shard.range))
                 if not sub:
                     continue
                 candidates = [n for n in shard.nodes if n != node.id]
                 if candidates:
                     plan.append((sub, candidates))
-                elif node.id in shard.nodes:
+                elif node.id in shard.nodes and not catch_up:
                     # we were the shard's only replica: our local copy IS the
                     # data, complete up to the fence by construction
                     pass
@@ -123,11 +136,17 @@ class ListStore(DataStore):
                     # attempt so bootstrap retries (ListStore.fetch contract,
                     # impl/list/ListStore.java)
                     fetch_ranges.fail(RuntimeError(
-                        f"no fetch source for {sub!r} (prior epoch {prior.epoch})"))
+                        f"no fetch source for {sub!r} "
+                        f"(epoch {source_topo.epoch}, catch_up={catch_up})"))
                     return au.success_result()
-        # anything the prior topology did not replicate at all is fresh
-        # key-space: trivially complete
         if not plan:
+            if catch_up:
+                # catch-up must never claim completeness without a source
+                fetch_ranges.fail(RuntimeError(
+                    f"no catch-up sources for {ranges!r} at epoch {epoch}"))
+                return au.success_result()
+            # anything the prior topology did not replicate is fresh
+            # key-space: trivially complete
             fetch_ranges.fetched(ranges)
             return au.success_result()
 

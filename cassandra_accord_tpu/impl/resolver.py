@@ -88,6 +88,11 @@ class DepsResolver:
         """The delivery window ended: drop any prefetched answers."""
 
     # -- execution-frontier plane (Commands WaitingOn mirror) -----------------
+    def is_indexed(self, txn_id: TxnId) -> bool:
+        """Does the device index hold this txn (frontier-exec eligibility)?
+        Host-only resolvers index nothing."""
+        return False
+
     def register_waiting(self, waiter: TxnId, deps) -> None:
         """The execute-phase wait graph: ``waiter`` blocks on ``deps``
         (Commands.initialiseWaitingOn, Commands.java:688).  Device resolvers
@@ -221,6 +226,9 @@ class VerifyDepsResolver(DepsResolver):
 
     def remove_waiting(self, waiter, dep) -> None:
         self.tpu.remove_waiting(waiter, dep)
+
+    def is_indexed(self, txn_id) -> bool:
+        return self.tpu.is_indexed(txn_id)
 
     def register(self, txn_id, status, execute_at, keys) -> None:
         self.cpu.register(txn_id, status, execute_at, keys)

@@ -555,6 +555,9 @@ class TpuDepsResolver(DepsResolver):
         return True, self._cache[sig], delta_ids
 
     # -- execution-frontier plane ---------------------------------------------
+    def is_indexed(self, txn_id: TxnId) -> bool:
+        return txn_id in self.txns
+
     def register_waiting(self, waiter: TxnId, deps) -> None:
         self.edges[waiter] = set(deps)
 

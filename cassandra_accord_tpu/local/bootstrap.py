@@ -26,11 +26,15 @@ class Bootstrap:
     """One bootstrap attempt for one store's added ranges at one epoch."""
 
     def __init__(self, node: "Node", store: "CommandStore", ranges: Ranges,
-                 epoch: int):
+                 epoch: int, catch_up: bool = False):
         self.node = node
         self.store = store
         self.ranges = ranges
         self.epoch = epoch
+        # catch-up mode: re-running the ladder IN PLACE for a stale range
+        # (data lost to a truncation gap) — fetch sources from fence-epoch
+        # peers instead of the prior topology (staleUntilAtLeast analog)
+        self.catch_up = catch_up
         self.result = au.settable()
         self.attempts = 0
 
@@ -100,9 +104,24 @@ class Bootstrap:
                 if not fetch_done.is_done():
                     fetch_done.set_failure(failure)
 
+        import inspect
+        supports_catch_up = "catch_up" in inspect.signature(
+            self.node.data_store.fetch).parameters
+
         def run(safe_store):
-            self.node.data_store.fetch(self.node, safe_store, self.ranges,
-                                       sync_point, FetchRanges())
+            if supports_catch_up:
+                self.node.data_store.fetch(self.node, safe_store, self.ranges,
+                                           sync_point, FetchRanges(),
+                                           catch_up=self.catch_up)
+            else:
+                # DataStore impls without catch-up support (SPI default);
+                # a catch-up Bootstrap REQUIRES the stronger contract
+                # (prior-topology mode can report lost ranges 'trivially
+                # complete', silently masking data loss)
+                assert not self.catch_up, \
+                    "catch-up bootstrap needs a catch_up-aware DataStore.fetch"
+                self.node.data_store.fetch(self.node, safe_store, self.ranges,
+                                           sync_point, FetchRanges())
 
         self.store.execute(run)
 

@@ -93,6 +93,11 @@ class CommandStore:
         # diagnostic: local apply-order inversions recorded by the per-key
         # timestamp registers (legal under MVCC; see timestamps_for_key.py)
         self.tfk_inversions = 0
+        # frontier-driven execution mode (burn harness): STABLE indexed txns
+        # park here instead of firing ReadyToExecute inline; the device
+        # kahn_frontier release task pops them (SURVEY §7 stage 8)
+        self.frontier_exec = False
+        self.exec_deferred: set = set()
         # per-key execution-timestamp registers (impl/TimestampsForKey.java):
         # last_write / last_executed / monotonic HLC, updated on the normal
         # execution path and merged on adoption/heal paths

@@ -261,11 +261,16 @@ def adopt_truncated_outcome(safe_store: SafeCommandStore, command: Command,
             safe_store.agent().on_uncaught_exception(failure)
             return
         # adoption lands writes out of dependency order: merge the per-key
-        # registers monotonically, no validation (the safeToReadAt-gated case)
+        # registers monotonically, no validation (the safeToReadAt-gated
+        # case).  Owned keys only — unowned registry entries would never GC
+        # (shard_redundant_before has no bound for them)
         if writes is not None and not writes.is_empty():
             tfk = safe_store.store.timestamps_for_key
+            owned = safe_store.store.all_ranges()
             for key in writes.keys:
-                tfk.merge_applied_write(key, execute_at)
+                rk = key.to_routing() if hasattr(key, "to_routing") else key
+                if owned.contains(rk):
+                    tfk.merge_applied_write(key, execute_at)
         command.partial_txn = None
         command.partial_deps = None
         command.waiting_on = None
@@ -494,8 +499,12 @@ def _root_blocker(safe_store: SafeCommandStore, command: Command):
 
 
 def maybe_execute(safe_store: SafeCommandStore, command: Command,
-                  always_notify_listeners: bool) -> bool:
-    """Fire ReadyToExecute / Applying when the frontier drains (Commands.java:617)."""
+                  always_notify_listeners: bool,
+                  from_frontier: bool = False) -> bool:
+    """Fire ReadyToExecute / Applying when the frontier drains (Commands.java:617).
+
+    ``from_frontier``: the call comes from the device-frontier release task
+    (frontier-driven execution mode) — bypass the exec_deferred parking."""
     if command.save_status not in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
         if always_notify_listeners:
             safe_store.notify_listeners(command)
@@ -518,6 +527,19 @@ def maybe_execute(safe_store: SafeCommandStore, command: Command,
         # frontier drained during notification but no one executed us: fall through
 
     if command.save_status is SaveStatus.STABLE:
+        # frontier-driven execution mode (SURVEY §7 stage 8: execute-phase
+        # topological wait on device): when enabled, an INDEXED key-domain
+        # txn whose event-driven frontier just drained is NOT fired inline —
+        # it parks in exec_deferred and only the device frontier
+        # (kahn_frontier over the resolver's mirrored wait graph) releases
+        # it.  The event path still does all bookkeeping, so a frontier that
+        # misses a ready txn stalls the burn (loud parity failure) rather
+        # than executing out of order.
+        store = safe_store.store
+        if store.frontier_exec and not from_frontier \
+                and store.resolver.is_indexed(command.txn_id):
+            store.exec_deferred.add(command.txn_id)
+            return False
         command.set_save_status(SaveStatus.READY_TO_EXECUTE)
         safe_store.progress_log().ready_to_execute(command)
         safe_store.notify_listeners(command)
@@ -602,9 +624,12 @@ def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
                 # here, or an adopted outcome): land its OWN writes locally
                 # before anything else — no network needed for this txn's gap
                 command.writes.apply_to(safe_store, safe_store.store.all_ranges())
+                owned = safe_store.store.all_ranges()
                 for key in command.writes.keys:
-                    safe_store.store.timestamps_for_key.merge_applied_write(
-                        key, command.execute_at)
+                    rk = key.to_routing() if hasattr(key, "to_routing") else key
+                    if owned.contains(rk):
+                        safe_store.store.timestamps_for_key.merge_applied_write(
+                            key, command.execute_at)
             # predecessors may be missing too (that is WHY this txn never
             # applied): stale-mark + peer-snapshot heal over the footprint
             from ..messages.status_messages import _heal_store_gaps

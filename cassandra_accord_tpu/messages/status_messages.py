@@ -361,12 +361,37 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
         return   # no peer can heal (lone replica): marking stale would
                  # permanently refuse reads with nothing to redirect to
     token = store.mark_stale(rngs)   # reads redirect until the gap heals
-    state = {"open": rngs}
+    state = {"open": rngs, "rounds": 0}
+    command_store = safe_store.store
+
+    def escalate() -> None:
+        """Bootstrap-grade catch-up (Bootstrap.java:83-494 re-run for stale
+        ranges / RedundantBefore.staleUntilAtLeast): after the paced
+        peer-snapshot heal has failed several rounds (sustained partition,
+        vanished peers), stop pacing and re-enter the full bootstrap ladder —
+        coordinate a fresh exclusive sync point over the open footprint,
+        stream the data (complete up to that NEW fence, so writes committed
+        DURING the outage are covered too), and advance bootstrapped_at.  The
+        ladder retries with its own backoff until peers return; the stale
+        mark clears only on completion."""
+        from ..local.bootstrap import Bootstrap
+
+        def on_done(_v, failure) -> None:
+            if failure is None:
+                store.clear_stale(token)
+        Bootstrap(node, command_store, state["open"], node.epoch(),
+                  catch_up=True).start().add_listener(on_done)
 
     def attempt(delay: float) -> None:
         """One heal round over the still-open footprint; unhealed remainder
         retries with capped backoff — partitions re-roll and churn replaces
-        replicas, so availability returns without re-exposing the hole."""
+        replicas, so availability returns without re-exposing the hole.
+        After several failed rounds the heal escalates to the bootstrap
+        fetch ladder (see ``escalate``)."""
+        state["rounds"] += 1
+        if state["rounds"] > 5:
+            escalate()
+            return
         next_delay = min(delay * 2, 16.0)
         plan = current_plan(state["open"])
         if not plan:

@@ -124,3 +124,32 @@ def test_sharded_frontier_matches():
         want = np.asarray(dk.kahn_frontier(
             jnp.asarray(adj[s]), jnp.asarray(status[s]), jnp.asarray(active[s])))
         assert (got[s] == want).all(), s
+
+
+def test_live_state_sharded_consult_parity():
+    """The live-state multichip path (parallel/live_dryrun.py): a real burn
+    builds every store's device index; the burn's own recorded consults are
+    answered by the mesh-sharded kernel with parity vs single-device."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from cassandra_accord_tpu import parallel
+    from cassandra_accord_tpu.ops import deps_kernels as dk
+    from cassandra_accord_tpu.parallel import live_dryrun as ld
+
+    n = 4
+    mesh = parallel.make_mesh(devices=jax.devices()[:n])
+    stores, recorder = ld.collect_live_state(n, seed=11, ops=40)
+    assert len(stores) == n
+    st = ld.stack_store_indexes(stores)
+    assert st["active"].any()
+    q, before, qkind, n_real = ld.build_query_batches(stores, recorder,
+                                                      st["key_inc"].shape[2])
+    assert n_real > 0
+    args = (st["live_inc"], st["key_inc"], st["ts"], st["txn_id"], st["kind"],
+            st["status"], st["active"], q, before, qkind)
+    consult = parallel.build_sharded_store_consult(mesh)
+    deps, gmax = consult(*(jnp.asarray(x) for x in args))
+    deps1, _ = jax.vmap(dk.consult)(*(jnp.asarray(x) for x in args))
+    assert np.array_equal(np.asarray(deps), np.asarray(deps1))
+    assert np.asarray(gmax).shape == (q.shape[1], 5)

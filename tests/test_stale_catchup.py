@@ -1,0 +1,110 @@
+"""Bootstrap-grade staleness catch-up (Bootstrap.java:83-494 rerun for stale
+ranges): a replica whose data plane was stale-marked under a sustained TOTAL
+partition must, once peers return, re-enter the bootstrap fetch ladder —
+fence, stream, advance bootstrapped_at — instead of retrying the paced
+peer-snapshot heal forever (the KNOWN_ISSUES open item)."""
+from cassandra_accord_tpu.harness.cluster import Cluster, LinkConfig
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.primitives.keys import IntKey, Range, Ranges
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v):
+    return IntKey(v)
+
+
+class SwitchableLinks(LinkConfig):
+    """Total partition of one node, switchable at runtime."""
+
+    def __init__(self, rng, isolated: int):
+        super().__init__(rng)
+        self.isolated = isolated
+        self.partitioned = False
+
+    def action(self, from_node: int, to_node: int, message=None) -> str:
+        if self.partitioned and self.isolated in (from_node, to_node):
+            return LinkConfig.DROP
+        return LinkConfig.DELIVER
+
+
+def test_total_partition_heal_escalates_to_bootstrap_ladder():
+    links = SwitchableLinks(RandomSource(7), isolated=3)
+    topo = Topology(1, [Shard(Range(k(0), k(1000)), [1, 2, 3])])
+    cluster = Cluster(topo, seed=42, link_config=links)
+
+    # committed data everywhere
+    writes = [cluster.nodes[1].coordinate(list_txn([], {k(5): f"v{i}"}))
+              for i in range(3)]
+    assert cluster.run_until(lambda: all(w.is_done() for w in writes))
+    cluster.run_until_idle()
+
+    # isolate node 3 and open a data gap on it (the truncated-outcome
+    # adoption scenario): stale-mark via the heal entry point
+    links.partitioned = True
+    node3 = cluster.nodes[3]
+    gap = Ranges.of(Range(k(0), k(1000)))
+    store3 = node3.command_stores.all_stores()[0]
+
+    def trigger(safe_store):
+        from cassandra_accord_tpu.messages.status_messages import \
+            _heal_store_gaps
+        _heal_store_gaps(node3, safe_store, gap)
+
+    store3.execute(trigger)
+    assert cluster.run_until(
+        lambda: len(node3.data_store.stale_ranges) > 0, max_tasks=200_000)
+
+    # paced heal rounds exhaust against the partition; the escalation enters
+    # the bootstrap ladder (pending_bootstrap marks the footprint)
+    assert cluster.run_until(
+        lambda: len(store3.pending_bootstrap) > 0, max_tasks=2_000_000), \
+        "heal never escalated to the bootstrap ladder"
+    # while partitioned, the ladder retries without completing
+    assert len(node3.data_store.stale_ranges) > 0
+
+    # partition heals -> the ladder completes: fence coordinated, data
+    # streamed from fence-epoch peers, stale + pending marks cleared
+    links.partitioned = False
+    assert cluster.run_until(
+        lambda: len(node3.data_store.stale_ranges) == 0
+        and len(store3.pending_bootstrap) == 0, max_tasks=4_000_000), \
+        "catch-up never completed after the partition healed"
+    # bootstrapped_at advanced over the footprint (the fence fences the past)
+    e = store3.redundant_before.entry(k(5).to_routing())
+    assert e is not None and e.bootstrapped_at is not None
+    # and the data plane is whole again: every committed write present
+    assert set(node3.data_store.get(k(5))) == {"v0", "v1", "v2"}
+
+
+def test_catch_up_fetch_refuses_without_sources():
+    """catch_up=True must never report 'trivially complete' when no peer is
+    reachable in the plan (the data exists; we lost it)."""
+    topo = Topology(1, [Shard(Range(k(0), k(1000)), [1])])
+    cluster = Cluster(topo, seed=3)
+    node = cluster.nodes[1]
+    store = node.command_stores.all_stores()[0]
+
+    failures = []
+
+    class FR:
+        def fetched(self, ranges):
+            failures.append(("fetched", ranges))
+
+        def fail(self, failure):
+            failures.append(("fail", failure))
+
+    class FakeSyncPoint:
+        from cassandra_accord_tpu.primitives.timestamp import (Domain, TxnId,
+                                                               TxnKind)
+        txn_id = TxnId(epoch=1, hlc=99, node=1,
+                       kind=TxnKind.EXCLUSIVE_SYNC_POINT, domain=Domain.RANGE)
+
+    def run(safe_store):
+        node.data_store.fetch(node, safe_store,
+                              Ranges.of(Range(k(0), k(1000))),
+                              FakeSyncPoint(), FR(), catch_up=True)
+
+    store.execute(run)
+    cluster.run_until(lambda: len(failures) > 0, max_tasks=100_000)
+    assert failures and failures[0][0] == "fail"
