@@ -175,6 +175,19 @@ def bench_kernel(t, k=512, b=256, iters=20, keys_per_txn=2, packed=False):
     for _ in range(3):
         host_tier(q, before, qkind)
     np_qps = 3 * b / (time.perf_counter() - t0)
+    # native C++ host engine (native/consult.cpp) on the same state/queries
+    native_qps = None
+    from cassandra_accord_tpu import native
+    if native.available():
+        from cassandra_accord_tpu.ops.graph_state import INVALIDATED
+        h = {"key_inc": key_inc, "live_inc": key_inc, "ts": lanes,
+             "txn_id": lanes, "kind": kind, "status": status, "active": active}
+        qcols = [np.nonzero(row)[0] for row in q]
+        native.consult_batch(h, qcols, before, qkind, INVALIDATED)  # warm
+        t0 = time.perf_counter()
+        for _ in range(3):
+            native.consult_batch(h, qcols, before, qkind, INVALIDATED)
+        native_qps = 3 * b / (time.perf_counter() - t0)
     py_qps = host_python_scalar(key_inc, lanes, active, q, before)
     matmul_flops = 2.0 * b * k * t
     tflops = dev_qps / b * matmul_flops / 1e12
@@ -183,6 +196,8 @@ def bench_kernel(t, k=512, b=256, iters=20, keys_per_txn=2, packed=False):
             "index_bytes_int8": 2 * t * k,
             "device_queries_per_sec": round(dev_qps, 1),
             "host_numpy_queries_per_sec": round(np_qps, 1),
+            "host_native_queries_per_sec":
+                round(native_qps, 1) if native_qps else None,
             "host_python_scalar_queries_per_sec": round(py_qps, 1),
             "device_vs_host_numpy": round(dev_qps / np_qps, 2),
             "device_join_tflops": round(tflops, 4)}

@@ -183,7 +183,8 @@ class DataStore(abc.ABC):
     class FetchResult:
         """AsyncResult of a fetch with abort()."""
 
-    def fetch(self, node, safe_store, ranges: "Ranges", sync_point, fetch_ranges: FetchRanges):
+    def fetch(self, node, safe_store, ranges: "Ranges", sync_point,
+              fetch_ranges: FetchRanges, catch_up: bool = False):
         """Fetch data for newly-adopted ranges up to ``sync_point``; default impl for
         in-memory stores completes immediately (harness ListStore overrides)."""
         raise NotImplementedError

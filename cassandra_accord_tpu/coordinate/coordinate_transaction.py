@@ -260,9 +260,24 @@ class _ExecuteTxn:
         # replica needs to serve the whole slice (ReadCoordinator capability;
         # without it, wide range reads deadlocked against bootstrap fences
         # under topology churn)
-        self._unread = {}
-        parts = route.participants()
+        # transient-nack read re-rounds: obsolete/unavailable mean a replica
+        # state that RESOLVES by itself (PRE_APPLIED drains to APPLIED, where
+        # the MVCC read serves; bootstrap completes) — when a read round
+        # exhausts on only those, re-run it after a beat instead of failing
+        # the whole (often recovery-driven) execution.  Bounded so a
+        # genuinely wedged footprint still escalates (sustained-chaos
+        # recovery livelocked exactly here: every copy raced to APPLIED and
+        # each attempt's reads exhausted on obsolete — seed-4 churn stall).
+        self.read_rounds = 0
+        self._read_retry_pending = False
+        self._init_unread()
+
+    MAX_READ_ROUNDS = 3
+
+    def _init_unread(self) -> None:
+        parts = self.route.participants()
         from ..primitives.keys import Ranges as _Rs
+        self._unread = {}
         for i, t in enumerate(self.read_tracker.trackers):
             if isinstance(parts, _Rs):
                 sl = parts.intersection(_Rs.of(t.shard.range))
@@ -272,6 +287,42 @@ class _ExecuteTxn:
                 ks = {k for k in parts if t.shard.range.contains(k)}
                 if ks:
                     self._unread[i] = ks
+
+    def retry_read_round_or_fail(self) -> None:
+        """A read round exhausted on TRANSIENT nacks (obsolete: the copy is
+        mid-apply and will serve from the MVCC snapshot once APPLIED;
+        unavailable: bootstrap in flight).  Re-run the round after a beat —
+        bounded, so a genuinely wedged footprint still fails the attempt.
+
+        One retry per ROUND: the tracker reports FAILED independently per
+        exhausted shard, and without the pending guard a multi-shard route
+        would burn the whole round budget (and launch racing duplicate
+        rounds) on a single exhaustion."""
+        if self._read_retry_pending:
+            return
+        if self.read_rounds >= self.MAX_READ_ROUNDS:
+            self.done = True
+            self.result.set_failure(Exhausted(self.txn_id, "read"))
+            return
+        self.read_rounds += 1
+        self._read_retry_pending = True
+
+        def go():
+            self._read_retry_pending = False
+            if self.done:
+                return
+            from ..topology.topology import Topologies
+            self.read_tracker = ReadTracker(Topologies([self.topologies.current()]))
+            self._init_unread()
+            # rotate the preferred replica per round: re-contacting the same
+            # (deterministically chosen) stuck copy every round re-creates
+            # the livelock the rounds exist to break
+            nodes = sorted(self.read_tracker.nodes())
+            prefer = nodes[self.read_rounds % len(nodes)] if nodes \
+                else self.node.id
+            for to in self.read_tracker.initial_contacts(prefer=prefer):
+                self.send_read_retry(to)
+        self.node.scheduler.once(0.15, go)
 
     @property
     def needs_read(self) -> bool:
@@ -304,8 +355,7 @@ class _ExecuteTxn:
                             return
                         status, retries = this.read_tracker.record_read_failure(from_node)
                         if status is RequestStatus.FAILED:
-                            this.done = True
-                            this.result.set_failure(Exhausted(this.txn_id, "read"))
+                            this.retry_read_round_or_fail()
                             return
                         for to in retries:
                             this.send_read_retry(to)
@@ -321,8 +371,7 @@ class _ExecuteTxn:
                         # (the Stable part already acked separately)
                         status, retries = this.read_tracker.record_read_failure(from_node)
                         if status is RequestStatus.FAILED:
-                            this.done = True
-                            this.result.set_failure(Exhausted(this.txn_id, "read"))
+                            this.retry_read_round_or_fail()
                             return
                         for to in retries:
                             this.send_read_retry(to)
@@ -359,10 +408,13 @@ class _ExecuteTxn:
                     this.send_read_retry(to)
 
         self.callback = ExecuteCallback()
-        for to in self.stable_tracker.nodes():
-            request = self.commit_for(to, read=to in read_nodes)
-            if request is not None:
-                self.node.send(to, request, self.callback)
+        # send_to_each: a node whose route scope slice is empty (topology
+        # churn) must FAIL its tracker slot, not silently skip — the same
+        # hang fixed in Node.send_to_each applies to this tracker too
+        self.node.send_to_each(
+            self.stable_tracker.nodes(),
+            lambda to: self.commit_for(to, read=to in read_nodes),
+            self.callback)
 
     def commit_for(self, to: int, read: bool) -> Optional[Commit]:
         scope = TxnRequest.compute_scope(to, self.topologies, self.route)
@@ -376,9 +428,8 @@ class _ExecuteTxn:
                       route=self.route)
 
     def send_read_retry(self, to: int) -> None:
-        request = self.commit_for(to, read=True)
-        if request is not None:
-            self.node.send(to, request, self.callback)
+        self.node.send_to_each([to], lambda t: self.commit_for(t, read=True),
+                               self.callback)
 
     def on_stable_ack(self, from_node: int) -> None:
         self.stable_tracker.record_success(from_node)

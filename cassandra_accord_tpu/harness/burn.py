@@ -332,7 +332,24 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 # a quorum answered and nothing witnessed it
                 resolve(obs, "lost")
             else:
-                retry()  # in flight somewhere; let recovery settle it
+                # in flight somewhere — but only SOME replica may have
+                # witnessed it, and if the home shard never did, NOTHING
+                # drives recovery (the progress log monitors only witnessed
+                # txns): a minority-witnessed orphan then stays PRE_ACCEPTED
+                # forever and the probe loops to its cap.  Tell the home
+                # shard it exists (InformOfTxnId.java role; the reference's
+                # ListRequest escalation) so MaybeRecover settles it —
+                # typically by invalidation — and the next probe resolves.
+                if attempt >= 2:
+                    from ..messages.status_messages import InformOfTxn
+                    topo = coordinator.config_service.current_topology()
+                    shard = topo.for_key(route.home_key)
+                    if shard is not None:
+                        for to in shard.nodes:
+                            coordinator.send(to, InformOfTxn(
+                                txn_id, route.home_key_only(),
+                                coordinator.epoch()))
+                retry()  # recovery (now informed) settles it
 
         check_status_quorum(coordinator, txn_id, route, include_info=True) \
             .to_chain().begin(on_checked)
@@ -434,7 +451,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 for cs in node.command_stores.all_stores())
         # data-plane telemetry (tpu/verify resolvers): batching + tier choices
         tel = {"prefetch_hits": 0, "prefetch_patched": 0, "prefetch_misses": 0,
-               "walk_consults": 0, "host_consults": 0, "device_consults": 0}
+               "walk_consults": 0, "host_consults": 0, "native_consults": 0,
+               "device_consults": 0}
         for node in cluster.nodes.values():
             for store in node.command_stores.all_stores():
                 r = getattr(store.resolver, "tpu", store.resolver)
@@ -502,7 +520,7 @@ def reconcile(seed: int, **kwargs) -> None:
         f"nondeterministic outcome for seed {seed}: {a} vs {b}"
     # tier-choice counters are cost-model (wall-clock) driven, not sim-driven:
     # exclude them from the determinism contract (answers are tier-invariant)
-    tier_keys = ("resolver_host_consults", "resolver_device_consults")
+    tier_keys = ("resolver_host_consults", "resolver_native_consults", "resolver_device_consults")
     sa = {k: v for k, v in a.stats.items() if k not in tier_keys}
     sb = {k: v for k, v in b.stats.items() if k not in tier_keys}
     assert sa == sb, \

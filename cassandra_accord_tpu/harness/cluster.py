@@ -57,6 +57,7 @@ class PendingQueue:
             entry = heapq.heappop(self._heap)
             if entry.cancelled:
                 continue
+            entry.popped = True
             if not entry.recurring:
                 self._live_nonrecurring -= 1
             self.now_micros = max(self.now_micros, entry.at)
@@ -70,7 +71,8 @@ class PendingQueue:
         return sum(1 for e in self._heap if not e.cancelled)
 
     class _Entry:
-        __slots__ = ("at", "seq", "task", "cancelled", "recurring", "_queue")
+        __slots__ = ("at", "seq", "task", "cancelled", "recurring", "popped",
+                     "_queue")
 
         def __init__(self, at: int, seq: int, task: Callable, recurring: bool = False,
                      queue: "PendingQueue" = None):
@@ -79,10 +81,19 @@ class PendingQueue:
             self.task = task
             self.cancelled = False
             self.recurring = recurring
+            # set when pop() hands the task out: cancel() after that must NOT
+            # decrement the live counter again — cancelling an already-run
+            # one-shot (e.g. CoordinateDurabilityScheduling.stop() sweeping
+            # its fired entries) double-decremented _live_nonrecurring, the
+            # queue then claimed idle while real timeouts still pended,
+            # run_until_idle exited early, hung bootstrap fences never timed
+            # out, and pending_bootstrap never cleared (seed-7 replica
+            # divergence at the final-agreement check)
+            self.popped = False
             self._queue = queue
 
         def cancel(self):
-            if not self.cancelled:
+            if not self.cancelled and not self.popped:
                 self.cancelled = True
                 if not self.recurring and self._queue is not None:
                     self._queue._live_nonrecurring -= 1

@@ -19,7 +19,8 @@ from ..maelstrom import codec
 from ..primitives.timestamp import TxnId
 
 _FIELDS = ("save_status", "durability", "route", "partial_txn", "partial_deps",
-           "promised", "accepted_or_committed", "execute_at", "writes", "result")
+           "promised", "accepted_or_committed", "execute_at", "writes", "result",
+           "applied_locally")
 _MISSING = object()
 
 

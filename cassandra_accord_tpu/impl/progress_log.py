@@ -183,6 +183,18 @@ class SimpleProgressLog(ProgressLog):
     def _poll(self) -> None:
         self.store.execute(lambda _safe_store: self._poll_in_store())
 
+    def _launch_staggered(self, launch) -> None:
+        """Spread investigation launches across the poll window instead of
+        firing the whole backlog at one tick: with hundreds of blocked txns a
+        same-tick herd of recoveries ballot-preempts itself faster than any
+        attempt completes (the sustained-chaos livelock class; the reference
+        staggers via randomized requeue delays, SimpleProgressLog.java)."""
+        if not hasattr(self, "_stagger_rng"):
+            self._stagger_rng = self.node.random.fork()
+        delay = 0.5 * self._stagger_rng.next_float()
+        self.node.scheduler.once(
+            delay, lambda: self.store.execute(lambda _s: launch()))
+
     def _poll_in_store(self) -> None:
         from ..coordinate.maybe_recover import ProgressToken
 
@@ -206,7 +218,7 @@ class SimpleProgressLog(ProgressLog):
             if state.in_cooldown():
                 continue
             state.progress = Progress.INVESTIGATING
-            self._investigate(state)
+            self._launch_staggered(lambda state=state: self._investigate(state))
 
         for txn_id in list(self.blocking.keys()):
             state = self.blocking.get(txn_id)
@@ -223,7 +235,8 @@ class SimpleProgressLog(ProgressLog):
             if state.in_cooldown():
                 continue
             state.progress = Progress.INVESTIGATING
-            self._resolve_blocked(state)
+            self._launch_staggered(
+                lambda state=state: self._resolve_blocked(state))
 
         for txn_id in list(self.non_home.keys()):
             state = self.non_home.get(txn_id)

@@ -213,7 +213,12 @@ class TpuDepsResolver(DepsResolver):
         self._walk: Optional[DepsResolver] = None
         self.walk_consults = 0
         self.host_consults = 0
+        self.native_consults = 0
         self.device_consults = 0
+        # host-tier engine: 'auto' uses the native C++ consult when built and
+        # the query key-counts are sparse (its O(B*T*k_q) walk beats the
+        # dense BLAS pass), 'numpy'/'native' force a rung
+        self._host_engine = os.environ.get("ACCORD_TPU_HOST_TIER", "auto")
         # execute-phase wait-graph mirror (Commands WaitingOn edges), the input
         # to the kernel-computed execution frontier
         self.edges: Dict[TxnId, Set[TxnId]] = {}
@@ -757,15 +762,32 @@ class TpuDepsResolver(DepsResolver):
     def _consult_host(self, q, before, kind, want_deps=True, want_max=True):
         """The join as one vectorized numpy pass (BLAS f32 matmuls — exact for
         0/1 values — + lane-wise lex compares).  Mirrors ops.deps_kernels.
-        consult bit-for-bit."""
+        consult bit-for-bit.  Sparse query batches route to the native C++
+        engine (native/consult.cpp) when it is built: protocol queries touch
+        1-3 keys, where its O(B·T·k_q) column walk beats the dense O(B·T·K)
+        BLAS pass with zero temporaries."""
+        if self._host_engine != "numpy":
+            from .. import native
+            if native.available():   # cached bool: free when not built
+                qcols = [np.nonzero(row)[0] for row in q]
+                nnz = sum(len(c) for c in qcols)
+                if self._host_engine == "native" or nnz <= 8 * len(qcols):
+                    self.native_consults += 1
+                    _, invalidated_i = _status_codes()
+                    deps, max_lanes = native.consult_batch(
+                        self._h, qcols, before, kind, invalidated_i,
+                        want_deps=want_deps, want_max=want_max)
+                    return deps, max_lanes
         self.host_consults += 1
         h = self._h
         if "key_inc_f32" not in h:
             # above the f32-mirror bound: cast per call (the cost model rarely
             # routes here at that scale — device tier amortizes far better)
             h = dict(h)
-            h["key_inc_f32"] = h["key_inc"].T.astype(np.float32)
-            h["live_f32"] = h["live_inc"].T.astype(np.float32)
+            h["key_inc_f32"] = np.ascontiguousarray(
+                h["key_inc"].T.astype(np.float32))
+            h["live_f32"] = np.ascontiguousarray(
+                h["live_inc"].T.astype(np.float32))
         committed_i, invalidated_i = _status_codes()
         deps = None
         if want_deps:
@@ -947,8 +969,12 @@ class TpuDepsResolver(DepsResolver):
             # persistent transposed f32 mirrors for the BLAS host tier; above
             # the bound the host tier casts per call (memory budget: the
             # canonical index stays 2 × T×K int8 bytes)
-            self._h["key_inc_f32"] = key_inc.T.astype(np.float32)
-            self._h["live_f32"] = live_inc.T.astype(np.float32)
+            # C-contiguous: the native engine streams these rows (a .T view
+            # would make astype F-contiguous and force a full copy per call)
+            self._h["key_inc_f32"] = np.ascontiguousarray(
+                key_inc.T.astype(np.float32))
+            self._h["live_f32"] = np.ascontiguousarray(
+                live_inc.T.astype(np.float32))
         self._device_clean = False
         self._dirty_txns.clear()
         self._clear_bits.clear()

@@ -95,9 +95,9 @@ class Command:
         # True iff the DEPENDENCY-ORDERED apply path ran here (_apply_writes):
         # every dep's write is then locally present.  Truncated-with-outcome
         # copies that adopted/landed writes out of order stay False — serving
-        # a read from them requires their gap to be stale-fenced.  Defaults
-        # False on journal reconstruction (conservative: reads refuse rather
-        # than risk a torn snapshot).
+        # a read from them requires their gap to be stale-fenced.  Journaled:
+        # a cache-miss fault-in must restore it, else evicted TRUNCATED_APPLY
+        # copies refuse reads they can serve and recovery livelocks return.
         self.applied_locally: bool = False
 
     # -- status queries -----------------------------------------------------

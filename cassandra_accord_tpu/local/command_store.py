@@ -192,33 +192,34 @@ class CommandStore:
 
     def _load_then(self, pending: list, start: Callable[[], None]) -> None:
         """The pending-load path (PreLoadContext.java /
-        AbstractSafeCommandStore's load machinery): each declared-cold id is
-        faulted in by a SEPARATE executor task before the operation task is
-        scheduled.  Under DelayedAgentExecutor every hop gets a random delay,
-        so other store tasks interleave with the load — the interleaving the
-        reference's cache-miss injection exists to stress
-        (DelayedCommandStores.java:138-195)."""
+        AbstractSafeCommandStore's load machinery): the declared-cold ids are
+        faulted in by ONE separate executor task before the operation task is
+        scheduled — the operation observes an async load boundary (under
+        DelayedAgentExecutor both hops get random delays, so other store
+        tasks interleave with the load: the interleaving the reference's
+        cache-miss injection exists to stress,
+        DelayedCommandStores.java:138-195).  One task for ALL of an
+        operation's loads, not one per id: per-id hops serialized a
+        delayed-store chain per cold dependency and ground hostile burns to
+        a crawl."""
         self.pending_loads += len(pending)
 
-        def load_one(i: int):
-            def run_load():
-                prev, CommandStore._current = CommandStore._current, self
-                try:
-                    if pending[i] in self.cold:
-                        self._fault_in(pending[i])
-                except BaseException as e:  # noqa: BLE001
-                    # a failed load must not strand the operation (the chain
-                    # would never settle and the request would hang): report
-                    # and continue — the op sees the id as absent/recreated
-                    self.agent().on_uncaught_exception(e)
-                finally:
-                    CommandStore._current = prev
-                    if i + 1 < len(pending):
-                        load_one(i + 1)
-                    else:
-                        start()
-            self.executor.execute(run_load)
-        load_one(0)
+        def run_loads():
+            prev, CommandStore._current = CommandStore._current, self
+            try:
+                for tid in pending:
+                    if tid in self.cold:
+                        try:
+                            self._fault_in(tid)
+                        except BaseException as e:  # noqa: BLE001
+                            # a failed load must not strand the operation
+                            # (the chain would never settle): report and
+                            # continue — the op sees the id as absent
+                            self.agent().on_uncaught_exception(e)
+            finally:
+                CommandStore._current = prev
+                start()
+        self.executor.execute(run_loads)
 
     def check_in_store(self) -> None:
         Invariants.check_state(CommandStore._current is self,
@@ -520,14 +521,19 @@ class SafeCommandStore:
             if cleanup is Cleanup.NO:
                 continue
             if cleanup is Cleanup.ERASE:
-                from .status import SaveStatus
                 parts = cmd.route.participants() if cmd.route is not None else None
-                if cmd.save_status is SaveStatus.INVALIDATED or (
-                        parts is not None
-                        and store.redundant_before.is_shard_redundant(txn_id, parts)):
+                if parts is not None \
+                        and store.redundant_before.is_shard_redundant(txn_id, parts):
                     # physically drop: late messages are fended off by the
-                    # shard-redundant guard in commands (_is_shard_redundant);
-                    # invalidated txns can only ever be re-invalidated
+                    # shard-redundant guard in commands (_is_shard_redundant).
+                    # INVALIDATED tombstones must ALSO wait for the shard
+                    # fence: deleting one destroys the ballot promise and the
+                    # decision evidence, so a later recovery re-creates the
+                    # txn fresh, adopts stale ACCEPTED evidence from a replica
+                    # the invalidation quorum never touched, and COMMITS a
+                    # txn that was already invalidated at a quorum (seed-4
+                    # fence trace: invalidate@[139] at {n1,n2,n4} erased,
+                    # then recover@[146] committed via n5's old accept).
                     del store.commands[txn_id]
                     store.transient_listeners.pop(txn_id, None)
                     if store.journal is not None:

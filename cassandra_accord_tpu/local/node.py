@@ -263,10 +263,31 @@ class Node(ConfigurationService.Listener):
 
     def send_to_each(self, nodes, request_factory: Callable[[int], Optional["Request"]],
                      callback: Optional["Callback"] = None) -> None:
+        skipped = []
         for to in nodes:
             request = request_factory(to)
             if request is not None:
                 self.send(to, request, callback)
+            elif callback is not None:
+                skipped.append(to)
+        if skipped:
+            # a factory returning None means the node has NO slice of the
+            # route in the contacted epochs (compute_scope under topology
+            # churn): the tracker still counts it, and silently skipping
+            # leaves that slot pending FOREVER — coordinations (most
+            # visibly bootstrap fence sync points) then hang un-settled and
+            # their store's pending_bootstrap never clears (seed-7 replica
+            # divergence).  Report each as an immediate failure so quorum
+            # accounting completes; scheduled async to keep callback
+            # re-entrancy out of the send loop.
+            def fail_skipped():
+                for to in skipped:
+                    try:
+                        callback.on_failure(to, RuntimeError(
+                            "no route scope for node in contacted epochs"))
+                    except BaseException as e:  # noqa: BLE001
+                        callback.on_callback_failure(to, e)
+            self.scheduler.once(0.0, fail_skipped)
 
     def reply(self, to: int, reply_context, reply: "Reply") -> None:
         self.message_sink.reply(to, reply_context, reply)

@@ -428,8 +428,6 @@ class Commit(TxnRequest):
         self.route = route if route is not None else scope
 
     def preload_ids(self):
-        # commit walks its deps to initialise WaitingOn (PreLoadContext
-        # .contextFor(txnId, deps) in the reference's Commit handler)
         if self.partial_deps is None:
             return (self.txn_id,)
         return (self.txn_id, *self.partial_deps.txn_ids())
@@ -693,16 +691,26 @@ def _read_when_ready(safe_store: SafeCommandStore, txn_id: TxnId,
             return True
         if command.save_status is SaveStatus.READY_TO_EXECUTE:
             return _serve_read(s, command, result, fallback_txn)
-        # PRE_APPLIED / APPLYING: deps not yet locally applied — the snapshot
-        # below executeAt is incomplete.  Keep waiting: apply completes
-        # locally (WaitingOn drain / progress-log recovery of deps) and the
-        # listener re-fires at APPLIED, where the read serves exclusively.
+        if command.save_status in (SaveStatus.PRE_APPLIED, SaveStatus.APPLYING):
+            # deps not yet locally applied — the executeAt snapshot is
+            # incomplete here.  NACK immediately (the reference's obsolete,
+            # ReadData.java:57-260): the coordinator's retry loop must stay
+            # in control.  PRE_APPLIED/APPLYING are TRANSIENT — the local
+            # drain reaches APPLIED, where the read serves from the MVCC
+            # snapshot — so the coordinator treats this as retry-later, not
+            # failure (see _ExecuteTxn's delayed read re-round).  Replica-
+            # side waiting (bounded or not) was tried and LIVELOCKED hostile
+            # burns: the wait pushes read replies past the coordinator's
+            # own timeout/preemption windows, so no recovery attempt ever
+            # completes (seed 1).
+            result.set_success("obsolete")
+            return True
         return False
 
     command = safe_store.get_or_create(txn_id)
     if not try_read(safe_store, command):
         def listener(s: SafeCommandStore, cmd):
-            if try_read(s, cmd):
+            if result.is_done() or try_read(s, cmd):
                 s.remove_transient_listener(txn_id, listener)
         safe_store.add_transient_listener(txn_id, listener)
     return result.to_chain()
