@@ -17,7 +17,7 @@ CoordinatePreAccept.java:51-164, Propose.java:1-234, CoordinationAdapter.java:48
 """
 from __future__ import annotations
 
-from typing import TYPE_CHECKING, Dict, List, Optional
+from typing import TYPE_CHECKING, Dict, List, Optional, Set
 
 from ..messages.base import Callback, FailureReply, TxnRequest
 from ..messages.txn_messages import (
@@ -301,6 +301,12 @@ class _ExecuteTxn:
         if self._read_retry_pending:
             return
         if self.read_rounds >= self.MAX_READ_ROUNDS:
+            # NOTE: rounds exhausted partly by hard (link FAILURE) replies
+            # still retry — in the chaos model link failures are transient
+            # (links re-randomize every few sim-seconds), and failing the
+            # attempt on any hard failure livelocks recovery churn (measured:
+            # hostile seed 0 stalls).  A shard whose candidates ALL hard-fail
+            # already Exhausts immediately via on_failure.
             self.done = True
             self.result.set_failure(Exhausted(self.txn_id, "read"))
             return
@@ -314,13 +320,11 @@ class _ExecuteTxn:
             from ..topology.topology import Topologies
             self.read_tracker = ReadTracker(Topologies([self.topologies.current()]))
             self._init_unread()
-            # rotate the preferred replica per round: re-contacting the same
+            # rotate EVERY shard's pick per round: re-contacting the same
             # (deterministically chosen) stuck copy every round re-creates
             # the livelock the rounds exist to break
-            nodes = sorted(self.read_tracker.nodes())
-            prefer = nodes[self.read_rounds % len(nodes)] if nodes \
-                else self.node.id
-            for to in self.read_tracker.initial_contacts(prefer=prefer):
+            for to in self.read_tracker.initial_contacts(
+                    prefer=self.node.id, rotate=self.read_rounds):
                 self.send_read_retry(to)
         self.node.scheduler.once(0.15, go)
 
@@ -491,14 +495,25 @@ class _ExecuteTxn:
         quorum of every shard has acked (PersistTxn.java; progress logs then
         stand down via InformDurable), or ``on_quorum_impossible`` once some
         shard can no longer reach an apply quorum.  MAXIMAL applies carry the
-        full txn definition so any replica can apply without prior state."""
+        full txn definition so any replica can apply without prior state.
+
+        When EVERY contacted replica acks, a second InformDurable wave
+        upgrades the txn to UNIVERSAL — per-txn universal durability is the
+        sound gate for transitive-elision (a merely-majority-applied txn may
+        be unapplied at the very replica a later txn's elided deps reach;
+        universality is what the range durability rounds proved when they
+        were the only gate)."""
         applied = AppliedTracker(self.topologies)
         this = self
+        contacted: List[int] = []
 
         class ApplyCallback(Callback):
             informed = False
+            acked: Set[int] = set()
+            impossible_universal = False
 
             def _failed(self, from_node: int) -> None:
+                self.impossible_universal = True
                 if applied.record_failure(from_node) is RequestStatus.FAILED \
                         and not self.informed:
                     self.informed = True
@@ -515,6 +530,10 @@ class _ExecuteTxn:
                     self.informed = True
                     if on_quorum_applied is not None:
                         on_quorum_applied()
+                self.acked.add(from_node)
+                if not self.impossible_universal \
+                        and len(self.acked) == len(contacted):
+                    this.inform_universal()
 
             def on_failure(self, from_node: int, failure: BaseException) -> None:
                 self._failed(from_node)
@@ -524,6 +543,7 @@ class _ExecuteTxn:
             scope = TxnRequest.compute_scope(to, self.topologies, self.route)
             if scope is None:
                 continue
+            contacted.append(to)
             wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
             ranges = _scope_ranges(self.node, scope, self.topologies.current_epoch)
             partial_txn = self.txn.slice(ranges, include_query=False) \
@@ -532,6 +552,20 @@ class _ExecuteTxn:
                 self.txn_id, scope, wait_for, apply_kind, self.execute_at,
                 self.deps.slice(ranges), partial_txn, writes.slice(ranges),
                 txn_result, route=self.route), callback)
+
+    def inform_universal(self) -> None:
+        """Every contacted replica acked its Apply: broadcast the UNIVERSAL
+        durability upgrade (widens the per-txn elision gate everywhere)."""
+        from ..local.status import Durability
+        from ..messages.status_messages import InformDurable
+        for to in self.topologies.nodes():
+            scope = TxnRequest.compute_scope(to, self.topologies, self.route)
+            if scope is None:
+                continue
+            wait_for = TxnRequest.compute_wait_for_epoch(to, self.topologies)
+            self.node.send(to, InformDurable(self.txn_id, scope, wait_for,
+                                             self.execute_at,
+                                             Durability.UNIVERSAL))
 
     def inform_durable(self) -> None:
         from ..local.status import Durability

@@ -173,14 +173,18 @@ class ReadTracker(AbstractTracker):
         super().__init__(topologies, ReadShardTracker)
         self._contacted: Set[int] = set()
 
-    def initial_contacts(self, prefer: Optional[int] = None) -> List[int]:
-        """Pick one replica per shard (preferring ``prefer`` — normally self)."""
+    def initial_contacts(self, prefer: Optional[int] = None,
+                         rotate: int = 0) -> List[int]:
+        """Pick one replica per shard (preferring ``prefer`` — normally self).
+
+        ``rotate`` shifts EVERY shard's pick index by that many positions, so
+        retry rounds contact a different replica per shard — a global
+        preferred node only rotates shards that contain it."""
         out: Set[int] = set()
         for t in self.trackers:
-            if prefer is not None and prefer in t.shard.nodes:
-                pick = prefer
-            else:
-                pick = t.shard.nodes[0]
+            nodes = t.shard.nodes
+            base = nodes.index(prefer) if prefer in nodes else 0
+            pick = nodes[(base + rotate) % len(nodes)]
             t.in_flight_reads.add(pick)
             out.add(pick)
         self._contacted.update(out)

@@ -427,14 +427,21 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             frontier_release_task.cancel()
             # txns parked AFTER the last release tick (run_until_idle stops
             # once only recurring tasks remain) are not frontier misses: keep
-            # releasing until the deferred sets stop draining, THEN judge
-            for _ in range(8):
-                if not any(cs.exec_deferred
+            # releasing while each round strictly shrinks the deferred set
+            # (a parked dependency chain can be arbitrarily deep at quiesce),
+            # and only judge once a round makes no progress
+            def _deferred_count():
+                return sum(len(cs.exec_deferred)
                            for n in cluster.nodes.values()
-                           for cs in n.command_stores.all_stores()):
-                    break
+                           for cs in n.command_stores.all_stores())
+            prev = _deferred_count()
+            while prev:
                 release_frontiers()
                 cluster.run_until_idle(max_tasks=max_tasks)
+                cur = _deferred_count()
+                if cur >= prev:
+                    break
+                prev = cur
             leftover = [(n.id, cs.id, sorted(cs.exec_deferred))
                         for n in cluster.nodes.values()
                         for cs in n.command_stores.all_stores()

@@ -104,6 +104,13 @@ class DepsResolver:
         """An edge drained (dep applied/invalidated/truncated or provably
         ordered after the waiter — Commands.java:704-775)."""
 
+    def mark_durable(self, txn_id: TxnId) -> None:
+        """Per-txn UNIVERSAL durability (Commands.set_durability crossing
+        UNIVERSAL — the coordinator saw every Apply ack): device-plane
+        resolvers widen their elision mirrors; host resolvers rely on the cfk
+        flag (cfk.mark_durable) and ignore this.  Majority durability is NOT
+        sufficient for elision — see commands.set_durability."""
+
     def _durable_majority(self, rk: RoutingKey) -> Optional[TxnId]:
         """The key's majority-durable watermark — the elision soundness gate
         (cfk.map_reduce_active doc).  Shared by BOTH data planes: the gate
@@ -165,22 +172,33 @@ class CpuDepsResolver(DepsResolver):
 
     def key_conflicts(self, by, keys, before):
         out: List[Tuple[RoutingKey, TxnId]] = []
+        # sync points are local-apply FENCES: their deps must wait on every
+        # txn not yet provably applied at EVERY replica, so the per-txn
+        # MAJORITY-durable elision flag does not apply to them (only the
+        # universal-grade watermark does) — eliding a merely-majority-applied
+        # txn from an exclusive sync point's deps lets mark_shard_durable
+        # claim universal application the barrier never proved, advancing
+        # truncation fences past unapplied txns (the round-5 stale-cascade)
+        flag = not by.kind.is_sync_point
         for rk in keys:
             cfk = self.store.cfks.get(rk)
             if cfk is not None:
                 cfk.map_reduce_active(before, by.witnesses,
                                       lambda t, _rk=rk: out.append((_rk, t)),
-                                      durable_majority=self._durable_majority(rk))
+                                      durable_majority=self._durable_majority(rk),
+                                      flag_elision=flag)
         return out
 
     def range_conflicts(self, by, rng, before):
         out: List[Tuple[RoutingKey, TxnId]] = []
+        flag = not by.kind.is_sync_point   # see key_conflicts
         for rk in sorted(self.store.cfks):
             if rng.contains(rk):
                 cfk = self.store.cfks[rk]
                 cfk.map_reduce_active(before, by.witnesses,
                                       lambda t, _rk=rk: out.append((_rk, t)),
-                                      durable_majority=self._durable_majority(rk))
+                                      durable_majority=self._durable_majority(rk),
+                                      flag_elision=flag)
         return out
 
     def max_conflict_keys(self, keys):
@@ -237,6 +255,10 @@ class VerifyDepsResolver(DepsResolver):
     def on_pruned(self, key, txn_ids) -> None:
         self.cpu.on_pruned(key, txn_ids)
         self.tpu.on_pruned(key, txn_ids)
+
+    def mark_durable(self, txn_id) -> None:
+        self.cpu.mark_durable(txn_id)
+        self.tpu.mark_durable(txn_id)
 
     def _check(self, what, a, b):
         check_state(a == b, "deps parity violation in %s: cpu=%s tpu=%s",

@@ -147,7 +147,8 @@ def _lex_max_rows(rows: np.ndarray) -> np.ndarray:
 class _TxnMirror:
     """Host bookkeeping for one indexed txn (rebuilds + attribution + the
     covered-key set for transitive elision)."""
-    __slots__ = ("slot", "kind_code", "status", "execute_at", "keys", "covered")
+    __slots__ = ("slot", "kind_code", "status", "execute_at", "keys", "covered",
+                 "durable")
 
     def __init__(self, slot: int, kind_code: int, status: int,
                  execute_at: Timestamp, keys: Set[RoutingKey]):
@@ -157,6 +158,7 @@ class _TxnMirror:
         self.execute_at = execute_at
         self.keys = keys
         self.covered: Set[RoutingKey] = set()
+        self.durable = False   # per-txn UNIVERSAL durability (elision gate)
 
 
 class TpuDepsResolver(DepsResolver):
@@ -179,10 +181,15 @@ class TpuDepsResolver(DepsResolver):
         heapq.heapify(self.free_key_slots)
         # transitive-elision bookkeeping (mirrors cfk._committed_writes +
         # the covering bound per key)
-        self.key_maxw: Dict[RoutingKey, Timestamp] = {}      # E_k
+        self.key_maxw: Dict[RoutingKey, Timestamp] = {}      # E_k (max cw ea)
+        self.key_maxw_tid: Dict[RoutingKey, TxnId] = {}      # that write's tid
         self.key_cw: Dict[RoutingKey, Dict[TxnId, Timestamp]] = {}
         self.key_uncovered: Dict[RoutingKey, Set[TxnId]] = {}
         self.key_covered: Dict[RoutingKey, Set[TxnId]] = {}
+        # max-conflict floor per key over PRUNED incidences (mirror of
+        # cfk._pruned_max): a timestamp proposal must exceed every txn the
+        # key ever witnessed, resident in the index or not
+        self.key_mc_floor: Dict[RoutingKey, Timestamp] = {}
         # elision soundness gate (cfk.map_reduce_active doc): a txn may only
         # be covered once below the key's MAJORITY-durable watermark; the
         # store bumps durable_gen on watermark advances and we re-sweep lazily
@@ -301,6 +308,15 @@ class TpuDepsResolver(DepsResolver):
             e_k = self.key_maxw.get(rk)
         if e_k is None or not m.execute_at < e_k:
             return False
+        if m.durable:
+            # the flag path additionally needs the covering write to have
+            # WITNESSED the entry (tid below the cover's tid): a reordered
+            # cover (ea above, tid below) never chained through it, and
+            # eliding it would break local-apply transitivity (cfk
+            # map_reduce_active's maxcw_tid condition)
+            tid_k = self.key_maxw_tid.get(rk)
+            if tid_k is not None and txn_id < tid_k:
+                return True
         if bound is None:
             bound = self._durable_majority(rk)
         return bound is not None and txn_id < bound
@@ -329,8 +345,35 @@ class TpuDepsResolver(DepsResolver):
                     # are unservable for the rest of the window
                     self._cache_hard.add(rk)
                 if e_k is None or m.execute_at > e_k:
+                    old_tid = self.key_maxw_tid.get(rk)
                     self.key_maxw[rk] = m.execute_at
+                    self.key_maxw_tid[rk] = txn_id
+                    if old_tid is not None and txn_id < old_tid:
+                        # REORDERED cover (ea up, tid down): flag-covered
+                        # entries above the new tid are no longer provably
+                        # witnessed by the cover — re-expose, then re-cover
+                        # whatever the watermark still allows
+                        self._unc_over_tid(rk, txn_id)
                     self._sweep(rk)
+
+    def _unc_over_tid(self, rk: RoutingKey, new_tid: TxnId) -> None:
+        """Un-cover entries whose cover validity depended on a higher frontier
+        tid; the follow-up _sweep re-covers any that remain eligible (e.g.
+        via the watermark path).  Un-covering is always safe — it only
+        re-emits."""
+        ks = self.key_slot.get(rk)
+        cov = self.key_covered.get(rk)
+        if ks is None or not cov:
+            return
+        self._cache = None
+        for t in [t for t in cov if not t < new_tid]:
+            mt = self.txns.get(t)
+            if mt is None:
+                continue
+            cov.discard(t)
+            mt.covered.discard(rk)
+            self.key_uncovered.setdefault(rk, set()).add(t)
+            self._live_ops.append((mt.slot, ks, 1))
 
     def _sweep(self, rk: RoutingKey) -> None:
         """The covering bound (E_k or the durability gate) advanced: cover
@@ -340,7 +383,7 @@ class TpuDepsResolver(DepsResolver):
             return
         e_k = self.key_maxw.get(rk)
         bound = self._durable_majority(rk)       # loop-invariant: hoisted
-        if e_k is None or bound is None:
+        if e_k is None:
             return
         for t in list(unc):
             mt = self.txns.get(t)
@@ -366,6 +409,31 @@ class TpuDepsResolver(DepsResolver):
         self.key_covered.setdefault(rk, set()).add(txn_id)
         self._live_ops.append((m.slot, self.key_slot[rk], 0))
 
+    def mark_durable(self, txn_id: TxnId) -> None:
+        """Per-txn UNIVERSAL durability (every Apply acked): the elision
+        gate widens for this txn on every key it touches (the device-plane
+        mirror of cfk.mark_durable)."""
+        m = self.txns.get(txn_id)
+        if m is None:
+            return
+        m.durable = True
+        self._dirty_txns.add(txn_id)   # h["durable"] row updates on flush
+        committed_i, invalidated_i = _status_codes()
+        if m.status < committed_i or m.status == invalidated_i \
+                or not TxnKind.WRITE.witnesses(TxnKind(m.kind_code)):
+            return
+        covered_any = False
+        for rk in list(m.keys - m.covered):
+            unc = self.key_uncovered.get(rk)
+            if unc is None or txn_id not in unc:
+                continue
+            if self._coverable_now(rk, txn_id, m):
+                unc.discard(txn_id)
+                self._cover(rk, txn_id, m)
+                covered_any = True
+        if covered_any:
+            self._cache = None   # cached answers predate the wider gate
+
     def on_pruned(self, key: RoutingKey, txn_ids) -> None:
         self._cache = None   # prunes mid-window are rare: drop the whole cache
         ks = self.key_slot.get(key)
@@ -377,6 +445,11 @@ class TpuDepsResolver(DepsResolver):
             m = self.txns.get(txn_id)
             if m is None or key not in m.keys:
                 continue
+            c = m.execute_at if not m.execute_at < txn_id.as_timestamp() \
+                else txn_id.as_timestamp()
+            f = self.key_mc_floor.get(key)
+            if f is None or c > f:
+                self.key_mc_floor[key] = c
             m.keys.discard(key)
             m.covered.discard(key)
             self._clear_bits.append((m.slot, ks))
@@ -403,24 +476,28 @@ class TpuDepsResolver(DepsResolver):
                 self.edges.pop(txn_id, None)
                 heapq.heappush(self.free_slots, m.slot)
         if cw_removed and key in self.key_slot:
-            # the covering bound may have receded: un-cover survivors at or
-            # above the new bound (cfk recomputes per query; we re-expose)
-            new_e = max(cw.values()) if cw else None
-            old_e = self.key_maxw.get(key)
-            if new_e != old_e:
-                if new_e is None:
-                    self.key_maxw.pop(key, None)
-                else:
-                    self.key_maxw[key] = new_e
-                for t in list(self.key_covered.get(key, ())):
-                    mt = self.txns.get(t)
-                    if mt is None:
-                        continue
-                    if new_e is None or not mt.execute_at < new_e:
-                        self.key_covered[key].discard(t)
-                        mt.covered.discard(key)
-                        self.key_uncovered.setdefault(key, set()).add(t)
-                        self._live_ops.append((mt.slot, ks, 1))
+            # the covering bound may have receded: un-cover survivors whose
+            # cover no longer holds (cfk recomputes per query; we re-expose)
+            if cw:
+                new_tid, new_e = max(cw.items(), key=lambda kv: (kv[1], kv[0]))
+            else:
+                new_tid, new_e = None, None
+            if new_e is None:
+                self.key_maxw.pop(key, None)
+                self.key_maxw_tid.pop(key, None)
+            else:
+                self.key_maxw[key] = new_e
+                self.key_maxw_tid[key] = new_tid
+            bound = self._durable_majority(key)
+            for t in list(self.key_covered.get(key, ())):
+                mt = self.txns.get(t)
+                if mt is None:
+                    continue
+                if not self._coverable_now(key, t, mt, new_e, bound):
+                    self.key_covered[key].discard(t)
+                    mt.covered.discard(key)
+                    self.key_uncovered.setdefault(key, set()).add(t)
+                    self._live_ops.append((mt.slot, ks, 1))
 
     def _release_key(self, key: RoutingKey) -> None:
         """Drop a live incidence; recycle the key slot when none remain (the
@@ -666,6 +743,11 @@ class TpuDepsResolver(DepsResolver):
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
             return []
+        if by.kind.is_sync_point:
+            # fence queries exclude the per-txn durable elision flag (see
+            # CpuDepsResolver.key_conflicts) — the covered bits bake it in,
+            # so sync points always take the exact walk
+            return self._walk_tier().key_conflicts(by, keys, before)
         if self._use_walk():
             return self._walk_tier().key_conflicts(by, keys, before)
         hit, ans, delta = self._cached(("kc", by, frozenset(known), before),
@@ -702,10 +784,22 @@ class TpuDepsResolver(DepsResolver):
 
     def max_conflict_keys(self, keys) -> Optional[Timestamp]:
         self._maybe_resweep_durable()   # see key_conflicts
+        floor: Optional[Timestamp] = None
+        for rk in keys:
+            f = self.key_mc_floor.get(rk)
+            if f is not None and (floor is None or f > floor):
+                floor = f
+
+        def with_floor(ts: Optional[Timestamp]) -> Optional[Timestamp]:
+            if ts is None:
+                return floor
+            return ts if floor is None or ts > floor else floor
+
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
-            return None
+            return floor
         if self._use_walk():
+            # the walk tier (cfk) carries its own pruned floor already
             return self._walk_tier().max_conflict_keys(keys)
         hit, ans, delta = self._cached(("mc", frozenset(known)), known, None,
                                        None)
@@ -719,18 +813,19 @@ class TpuDepsResolver(DepsResolver):
                             else d.as_timestamp()
                         if ans is None or ans < c:
                             ans = c
-            return ans
+            return with_floor(ans)
         q = np.zeros((1, self._k), dtype=np.int8)
         for rk in known:
             q[0, self.key_slot[rk]] = 1
         _, lanes = self._consult(q, np.zeros((1, TS_LANES), dtype=np.int32),
                                  np.zeros((1,), dtype=np.int8), want_deps=False)
         ts = Timestamp.unpack_lanes(tuple(int(v) for v in lanes[0]))
-        return None if ts == Timestamp.NONE else ts
+        return with_floor(None if ts == Timestamp.NONE else ts)
 
     def max_conflict_range(self, rng: Range) -> Optional[Timestamp]:
-        keys = [rk for rk in self.key_slot if rng.contains(rk)]
-        return self.max_conflict_keys(keys)
+        keys = {rk for rk in self.key_slot if rng.contains(rk)}
+        keys |= {rk for rk in self.key_mc_floor if rng.contains(rk)}
+        return self.max_conflict_keys(sorted(keys))
 
     # -- the fused consult: tier dispatch ------------------------------------
     def _consult(self, q: np.ndarray, before: np.ndarray, kind: np.ndarray,
@@ -890,11 +985,25 @@ class TpuDepsResolver(DepsResolver):
             cand = col & started & wit & eligible
             cw = col & committed & is_w & ea_before
             bound = self._durable_majority(rk)
-            if cw.any() and bound is not None:
-                maxcw = _lex_max_rows(h["ts"][cw])
-                bound_lanes = np.asarray(_pack_before(bound), dtype=np.int64)
-                elide = committed & _lex_less(h["ts"], maxcw) & write_wit \
-                    & _lex_less(h["txn_id"], bound_lanes)
+            if cw.any():
+                # the covering write = lexicographic max (executeAt, txnId)
+                # among committed writes before the bound — BOTH coordinates,
+                # matching cfk._covering_write_before (vectorized lexsort:
+                # np.lexsort keys are least-significant FIRST)
+                idx = np.nonzero(cw)[0]
+                combined = np.concatenate([h["ts"][idx], h["txn_id"][idx]],
+                                          axis=1)
+                best = idx[np.lexsort(combined.T[::-1])[-1]]
+                maxcw = h["ts"][best]
+                maxcw_tid = h["txn_id"][best]
+                # durability gate: (per-txn flag AND witnessed by the cover)
+                # OR below the key's majority watermark — bit-identical to
+                # cfk.map_reduce_active
+                gate = h["durable"] & _lex_less(h["txn_id"], maxcw_tid)
+                if bound is not None:
+                    bound_lanes = np.asarray(_pack_before(bound), dtype=np.int64)
+                    gate = gate | _lex_less(h["txn_id"], bound_lanes)
+                elide = committed & _lex_less(h["ts"], maxcw) & write_wit & gate
                 cand = cand & ~elide
             for slot in np.nonzero(cand)[0]:
                 tid = self.txn_at.get(int(slot))
@@ -952,6 +1061,7 @@ class TpuDepsResolver(DepsResolver):
         kind = np.zeros((t,), dtype=np.int8)
         status = np.zeros((t,), dtype=np.int8)
         active = np.zeros((t,), dtype=np.bool_)
+        durable = np.zeros((t,), dtype=np.bool_)
         for tid, m in self.txns.items():
             cols = [self.key_slot[rk] for rk in m.keys]
             key_inc[m.slot, cols] = 1
@@ -962,9 +1072,10 @@ class TpuDepsResolver(DepsResolver):
             kind[m.slot] = m.kind_code
             status[m.slot] = m.status
             active[m.slot] = True
+            durable[m.slot] = m.durable
         self._h = {"key_inc": key_inc, "live_inc": live_inc,
                    "ts": ts, "txn_id": txn_id, "kind": kind, "status": status,
-                   "active": active}
+                   "active": active, "durable": durable}
         if t <= self._f32_max:
             # persistent transposed f32 mirrors for the BLAS host tier; above
             # the bound the host tier casts per call (memory budget: the
@@ -1012,6 +1123,7 @@ class TpuDepsResolver(DepsResolver):
                 h["key_inc_f32"][:, d] = 0.0
                 h["live_f32"][:, d] = 0.0
             h["status"][d] = 0
+            h["durable"][d] = False
             self._deactivate.clear()
         for tid in sorted(self._dirty_txns):    # deterministic flush order
             m = self.txns[tid]
@@ -1032,6 +1144,7 @@ class TpuDepsResolver(DepsResolver):
             h["kind"][row] = m.kind_code
             h["status"][row] = m.status
             h["active"][row] = True
+            h["durable"][row] = m.durable
         self._dirty_txns.clear()
         # chronological cover/uncover flips: rows written above already carry
         # the final covered state, so replaying (whose last op per incidence

@@ -62,7 +62,7 @@ _DECIDED = (InternalStatus.COMMITTED, InternalStatus.STABLE, InternalStatus.APPL
 
 
 class TxnInfo:
-    __slots__ = ("txn_id", "status", "execute_at", "ballot")
+    __slots__ = ("txn_id", "status", "execute_at", "ballot", "durable")
 
     def __init__(self, txn_id: TxnId, status: InternalStatus,
                  execute_at: Optional[Timestamp] = None, ballot=None):
@@ -70,6 +70,9 @@ class TxnInfo:
         self.status = status
         self.execute_at = execute_at if execute_at is not None else txn_id
         self.ballot = ballot
+        # outcome applied at EVERY replica (per-txn InformDurable(UNIVERSAL) /
+        # the range durability watermark) — the elision soundness gate
+        self.durable = False
 
     def __lt__(self, other: "TxnInfo") -> bool:
         return self.txn_id < other.txn_id
@@ -82,7 +85,9 @@ class CommandsForKey:
     """Mutable per-key index (the safe/command-store layer guards all access)."""
 
     __slots__ = ("key", "by_id", "prune_before", "_max_applied_write",
-                 "_unmanaged_waiting", "_committed_writes")
+                 "_max_applied_write_tid", "_unmanaged_waiting",
+                 "_committed_writes", "cold", "_cold_max_ea", "_cold_max_tid",
+                 "_pruned_max")
 
     def __init__(self, key: RoutingKey):
         self.key = key
@@ -95,21 +100,37 @@ class CommandsForKey:
         # committed-or-later WRITEs sorted by executeAt (fixed at commit) —
         # the covering-write index for transitive elision (the reference's
         # committedByExecuteAt restricted to writes, CommandsForKey.java:929-944)
+        # NOTE: retains demoted (cold) writes — maxcw must not recede
         self._committed_writes: List[tuple] = []    # (execute_at, txn_id)
+        # the COLD tier: terminal (applied/invalidated) universally-durable
+        # entries demoted out of the hot walk.  The hot-only walk is exact
+        # for any query bound whose covering write executes after every cold
+        # entry (`_cold_max_ea`); stale bounds take the merged walk — the
+        # semantics of an unsplit by_id are preserved bit-for-bit, this is
+        # purely the O(history) -> O(concurrency) walk-cost fix (the
+        # reference bounds the same walk with prunedBefore + loadingPruned,
+        # CommandsForKey.java:115-143; we can afford to keep the cold map)
+        self.cold: Dict[TxnId, TxnInfo] = {}
+        self._cold_max_ea: Optional[Timestamp] = None   # max ea of emittable cold
+        self._cold_max_tid: Optional[TxnId] = None      # max tid of emittable cold
+        self._pruned_max: Optional[Timestamp] = None    # max ts floor of removed
+        self._max_applied_write_tid: Optional[TxnId] = None
 
     # -- lookup -------------------------------------------------------------
     def get(self, txn_id: TxnId) -> Optional[TxnInfo]:
         i = bisect_left(self.by_id, TxnInfo(txn_id, InternalStatus.TRANSITIVELY_KNOWN))
         if i < len(self.by_id) and self.by_id[i].txn_id == txn_id:
             return self.by_id[i]
-        return None
+        return self.cold.get(txn_id)
 
     def max_hlc(self) -> int:
         return max((info.txn_id.hlc for info in self.by_id), default=0)
 
     def max_timestamp(self) -> Optional[Timestamp]:
-        """Max of txnId/executeAt witnessed on this key (for timestamp proposal)."""
-        out: Optional[Timestamp] = None
+        """Max of txnId/executeAt witnessed on this key (for timestamp proposal).
+        ``_pruned_max`` floors the answer past demotion/pruning: a proposal must
+        exceed every write the key EVER witnessed, resident or not."""
+        out: Optional[Timestamp] = self._pruned_max
         for info in self.by_id:
             c = info.execute_at if info.execute_at > info.txn_id else info.txn_id
             if out is None or c > out:
@@ -130,6 +151,11 @@ class CommandsForKey:
         cfk/CommandsForKey.java:115-143: ids below the prune point are
         implied-applied and served by the RedundantBefore floor deps)."""
         if not manages(txn_id):
+            return False
+        if txn_id in self.cold:
+            # demoted terminal entry: nothing can upgrade it, and a late
+            # message must not re-index it (same resurrection guard as the
+            # prune path below)
             return False
         probe = TxnInfo(txn_id, status, execute_at)
         i = bisect_left(self.by_id, probe)
@@ -154,6 +180,9 @@ class CommandsForKey:
                 if execute_at is not None and was < InternalStatus.COMMITTED:
                     info.execute_at = execute_at
                 self._maybe_index_committed_write(info, was)
+                if self._demotable(info):
+                    self.by_id.pop(i)
+                    self._demote(info)
             elif (status == info.status and execute_at is not None
                   and status is InternalStatus.ACCEPTED):
                 info.execute_at = execute_at
@@ -164,6 +193,8 @@ class CommandsForKey:
             ea = execute_at if execute_at is not None else txn_id
             if self._max_applied_write is None or ea > self._max_applied_write:
                 self._max_applied_write = ea
+                self._max_applied_write_tid = txn_id
+                self._demote_sweep()   # older durable frontier entries now covered
         return True
 
     def witness_transitively(self, txn_id: TxnId) -> None:
@@ -183,13 +214,25 @@ class CommandsForKey:
         """ExecuteAt of the latest committed WRITE executing strictly before
         ``before`` — the covering write for transitive elision
         (CommandsForKey.java:929-944)."""
+        cw = self._covering_write_before(before)
+        return cw[0] if cw is not None else None
+
+    def _covering_write_before(self, before: Timestamp) -> Optional[tuple]:
+        """(execute_at, txn_id) of the covering write — elision needs BOTH
+        coordinates: a covered txn must execute before the cover AND have been
+        witnessable by it (txn_id below the cover's), else the cover's own
+        global deps never chained through it and eliding it breaks the
+        local-apply transitivity fences rely on (round-5 stale-cascade #2:
+        a REORDERED covering write — executeAt above, txnId below — elided
+        entries it never witnessed)."""
         i = bisect_left(self._committed_writes, (before,)) - 1
-        return self._committed_writes[i][0] if i >= 0 else None
+        return self._committed_writes[i] if i >= 0 else None
 
     # -- dependency calculation (the HOT query; CommandsForKey.java:925-1000) ----
     def map_reduce_active(self, before: Timestamp, witnesses: Callable[[TxnId], bool],
                           fn: Callable[[TxnId], None],
-                          durable_majority: Optional[TxnId] = None) -> None:
+                          durable_majority: Optional[TxnId] = None,
+                          flag_elision: bool = True) -> None:
         """Visit every active managed txn with txnId < before that the caller's
         kind witnesses — MINUS committed txns transitively covered by the
         latest committed write executing before the bound (elision, module
@@ -208,8 +251,23 @@ class CommandsForKey:
         before any fast-path deciphering.  The hostile burn demonstrated the
         violation (a fast-committed range read invalidated by elision-poisoned
         evidence) before this gate."""
-        maxcw = self.max_committed_write_before(before)
-        for info in self.by_id:
+        cw = self._covering_write_before(before)
+        maxcw, maxcw_tid = cw if cw is not None else (None, None)
+        entries: List[TxnInfo] = self.by_id
+        # the hot-only walk is exact iff every EMITTABLE cold entry would be
+        # elided at this bound: flag elision applies (not a sync-point
+        # query) and the bound's covering write dominates every cold entry
+        # on BOTH coordinates (invalidated cold entries are never emitted)
+        hot_only = self._cold_max_ea is None or (
+            flag_elision and maxcw is not None
+            and self._cold_max_ea < maxcw and self._cold_max_tid < maxcw_tid)
+        if self.cold and not hot_only:
+            # sync-point query or stale bound — take the merged walk,
+            # bit-identical to an unsplit index.  Common bounds from normal
+            # txns sit above every cold entry's covering write and walk the
+            # hot tier only: O(concurrency), not O(history).
+            entries = sorted(list(self.cold.values()) + self.by_id)
+        for info in entries:
             if info.txn_id >= before:
                 break
             st = info.status
@@ -219,8 +277,10 @@ class CommandsForKey:
             if not witnesses(info.txn_id):
                 continue
             if maxcw is not None and st in _DECIDED \
-                    and durable_majority is not None \
-                    and info.txn_id < durable_majority \
+                    and ((flag_elision and info.durable
+                          and info.txn_id < maxcw_tid)
+                         or (durable_majority is not None
+                             and info.txn_id < durable_majority)) \
                     and info.execute_at < maxcw \
                     and TxnKind.WRITE.witnesses(info.txn_id.kind):
                 continue    # ordered (and witnessed) by the covering write
@@ -292,12 +352,87 @@ class CommandsForKey:
                 return False
         return True
 
+    # -- per-txn durability + hot/cold demotion ------------------------------
+    def _note_removed_max(self, info: TxnInfo) -> None:
+        c = info.execute_at if info.execute_at > info.txn_id else info.txn_id
+        if self._pruned_max is None or c > self._pruned_max:
+            self._pruned_max = c
+
+    def _demotable(self, info: TxnInfo) -> bool:
+        """May this entry leave the hot walk?  INVALIDATED entries are never
+        emitted/blocking at any bound; APPLIED entries must be universally durable,
+        WRITE-witnessed, and strictly below the latest applied write — keeping
+        the covering write itself hot guarantees fresh query bounds see
+        ``maxcw > _cold_max_ea`` and stay on the O(concurrency) hot walk."""
+        if info.status is InternalStatus.INVALIDATED:
+            return True
+        return (info.status is InternalStatus.APPLIED and info.durable
+                and TxnKind.WRITE.witnesses(info.txn_id.kind)
+                and self._max_applied_write is not None
+                and info.execute_at < self._max_applied_write
+                and self._max_applied_write_tid is not None
+                and info.txn_id < self._max_applied_write_tid)
+
+    def _demote_sweep(self) -> None:
+        """The max applied write advanced: entries that were the frontier when
+        flagged durable (applies land roughly in executeAt order, so the
+        newest write never passes the cover check at its own apply) are now
+        covered — demote them."""
+        demoted = False
+        keep: List[TxnInfo] = []
+        for info in self.by_id:
+            if self._demotable(info):
+                self._demote(info)
+                demoted = True
+            else:
+                keep.append(info)
+        if demoted:
+            self.by_id = keep
+
+    def _demote(self, info: TxnInfo) -> None:
+        self.cold[info.txn_id] = info
+        self._note_removed_max(info)
+        if info.status is not InternalStatus.INVALIDATED:
+            ea = info.execute_at
+            if self._cold_max_ea is None or ea > self._cold_max_ea:
+                self._cold_max_ea = ea
+            if self._cold_max_tid is None or info.txn_id > self._cold_max_tid:
+                self._cold_max_tid = info.txn_id
+
+    def mark_durable(self, txn_id: TxnId) -> None:
+        """The txn's outcome is applied at EVERY replica (per-txn
+        InformDurable(UNIVERSAL) after the coordinator saw all Apply acks, or
+        a durability watermark advance).  Widens the elision gate for this
+        entry NOW — instead of waiting for the next range durability round —
+        and demotes it to the cold tier once terminal."""
+        i = bisect_left(self.by_id, TxnInfo(txn_id, InternalStatus.TRANSITIVELY_KNOWN))
+        if i >= len(self.by_id) or self.by_id[i].txn_id != txn_id:
+            return
+        info = self.by_id[i]
+        info.durable = True
+        if self._demotable(info):
+            self.by_id.pop(i)
+            self._demote(info)
+
+    def mark_durable_below(self, bound: TxnId) -> None:
+        """Range durability watermark advance: flag + demote everything below."""
+        keep: List[TxnInfo] = []
+        for info in self.by_id:
+            if info.txn_id < bound:
+                info.durable = True
+                if self._demotable(info):
+                    self._demote(info)
+                    continue
+            keep.append(info)
+        if len(keep) != len(self.by_id):
+            self.by_id = keep
+
     # -- pruning (doc CommandsForKey.java:115-143) ---------------------------
     def _prune(self, prunable: Callable[["TxnInfo"], bool]) -> List[TxnId]:
-        """Drop APPLIED/INVALIDATED entries matching ``prunable``; prune_before
-        is retained so late-arriving deps below it are treated as
-        already-applied rather than unknown.  Returns the pruned ids (the
-        resolver data plane evicts the same incidences)."""
+        """Drop APPLIED/INVALIDATED entries matching ``prunable`` (hot and
+        cold tiers); prune_before is retained so late-arriving deps below it
+        are treated as already-applied rather than unknown.  Returns the
+        pruned ids (the resolver data plane evicts the same incidences)."""
         keep: List[TxnInfo] = []
         pruned: List[TxnId] = []
         highest: Optional[TxnId] = self.prune_before
@@ -305,10 +440,16 @@ class CommandsForKey:
             if info.status in (InternalStatus.APPLIED, InternalStatus.INVALIDATED) \
                     and prunable(info):
                 pruned.append(info.txn_id)
+                self._note_removed_max(info)
                 if highest is None or info.txn_id > highest:
                     highest = info.txn_id
             else:
                 keep.append(info)
+        for txn_id in [t for t, info in self.cold.items() if prunable(info)]:
+            del self.cold[txn_id]
+            pruned.append(txn_id)
+            if highest is None or txn_id > highest:
+                highest = txn_id
         if pruned:
             self.by_id = keep
             self.prune_before = highest

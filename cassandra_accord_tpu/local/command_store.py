@@ -394,6 +394,26 @@ class SafeCommandStore:
             if indexed:
                 self.store.resolver.register(command.txn_id, status, ea, indexed)
 
+    def mark_txn_durable(self, command: Command) -> None:
+        """Per-txn majority durability (InformDurable after the coordinator's
+        apply quorum, Commands.setDurability → cfk): widen the per-key elision
+        gate for this txn immediately and let terminal entries demote out of
+        the hot walk (cfk.mark_durable)."""
+        if command.route is None:
+            return
+        scope = command.route.participants()
+        if isinstance(scope, Ranges):
+            return    # range txns are indexed in range_txns, not cfk
+        local = self.store.current_ranges()
+        for key in scope:
+            rk = key.to_routing() if hasattr(key, "to_routing") else key
+            if not local.contains(rk):
+                continue
+            cfk = self.store.cfks.get(rk)
+            if cfk is not None:
+                cfk.mark_durable(command.txn_id)
+        self.store.resolver.mark_durable(command.txn_id)
+
     def journal_save(self, command: Command) -> None:
         """Record the command's durable state in the attached journal (no-op
         without one) — the persistence contract hook (impl/basic/Journal)."""
@@ -540,8 +560,13 @@ class SafeCommandStore:
                         store.journal.erase(store, txn_id)
                     continue
             C.truncate(self, cmd, cleanup)
-        # prune conflict indexes below the shard-applied bound per key
+        # prune conflict indexes below the shard-applied bound per key, and
+        # flag/demote entries below the majority-durable watermark (entries
+        # that never saw a per-txn InformDurable still leave the hot walk)
         for rk, cfk in store.cfks.items():
+            e = store.durable_before.entry(rk)
+            if e is not None and e.majority_before is not None:
+                cfk.mark_durable_below(e.majority_before)
             bound = store.redundant_before.shard_redundant_before(rk)
             if bound is not None:
                 store.resolver.on_pruned(rk, cfk.prune_applied_before(bound))

@@ -678,8 +678,18 @@ def set_durability(safe_store: SafeCommandStore, txn_id: TxnId, durability: Dura
     if execute_at is not None and not command.has_been(Status.PRE_COMMITTED):
         command.execute_at = execute_at
     if durability > command.durability:
+        was = command.durability
         command.durability = durability
         safe_store.progress_log().durable(command)
+        if durability >= Durability.UNIVERSAL and was < Durability.UNIVERSAL:
+            # the outcome is applied at EVERY replica (the coordinator saw
+            # all Apply acks — inform_universal): widen the per-key elision
+            # gate NOW instead of at the next range durability round — this
+            # is what keeps per-op deps cost flat with history.  MAJORITY is
+            # NOT sufficient: a later txn's elided deps can reach the very
+            # replica the majority missed, whose local apply order then
+            # silently loses the elided txn (round-5 stale-cascade)
+            safe_store.mark_txn_durable(command)
     safe_store.journal_save(command)   # route/execute_at may have changed too
     return command
 

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import platform
 import subprocess
 from typing import Optional, Tuple
 
@@ -22,20 +23,31 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "consult.cpp")
-_LIB = os.path.join(_DIR, "_consult.so")
+# -march=native output is host-specific: tag the cache by machine so a shared
+# checkout across heterogeneous hosts never dlopens another arch's build
+_LIB = os.path.join(_DIR, f"_consult_{platform.machine()}.so")
 
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
 def _build() -> bool:
+    # compile to a private temp path and rename into place: rename is atomic
+    # on the same filesystem, so concurrent builders (parallel pytest, burns)
+    # never dlopen a partially-written .so
+    tmp = f"{_LIB}.tmp.{os.getpid()}"
     try:
         subprocess.run(
             ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-             "-o", _LIB, _SRC],
+             "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 
@@ -58,7 +70,7 @@ def _load() -> Optional[ctypes.CDLL]:
     u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
     i32p = np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS")
     c = lib.consult_batch
-    c.restype = None
+    c.restype = ctypes.c_int
     c.argtypes = [f32p, f32p, i32p, i32p, i8p, i8p, u8p,
                   ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
                   i32p, ctypes.c_int32, i32p, i8p, ctypes.c_int32,
@@ -102,6 +114,7 @@ def consult_batch(h: dict, qcols_list, before: np.ndarray, kind: np.ndarray,
     assert lib is not None, "native consult unavailable"
     T, K = h["key_inc"].shape
     lanes = h["ts"].shape[1]
+    assert lanes <= 8, f"native consult supports <=8 lanes, got {lanes}"
     B = len(qcols_list)
     max_q = max((len(c) for c in qcols_list), default=1) or 1
     qcols = np.full((B, max_q), -1, dtype=np.int32)
@@ -120,7 +133,7 @@ def consult_batch(h: dict, qcols_list, before: np.ndarray, kind: np.ndarray,
     if live_T is None or key_T is None:
         live_T = np.ascontiguousarray(h["live_inc"].T.astype(np.float32))
         key_T = np.ascontiguousarray(h["key_inc"].T.astype(np.float32))
-    lib.consult_batch(
+    rc = lib.consult_batch(
         np.ascontiguousarray(live_T),
         np.ascontiguousarray(key_T),
         np.ascontiguousarray(h["ts"]),
@@ -135,4 +148,7 @@ def consult_batch(h: dict, qcols_list, before: np.ndarray, kind: np.ndarray,
         1 if want_deps else 0, 1 if want_max else 0,
         out_deps.ctypes.data_as(ctypes.c_void_p) if want_deps else None,
         out_max.ctypes.data_as(ctypes.c_void_p) if want_max else None)
+    if rc != 0:
+        # a silent all-zero result would read as "no dependencies" — fail loud
+        raise RuntimeError(f"native consult_batch failed (rc={rc})")
     return (out_deps.astype(bool) if want_deps else None, out_max)

@@ -43,7 +43,10 @@ static inline bool ts_less(const int32_t* a, const int32_t* b, int lanes) {
 // as-is so the native tier adds no index bookkeeping of its own.
 // out_deps: [B*T] uint8 (may be null when want_deps == 0)
 // out_max:  [B*lanes] int64 (may be null when want_max == 0)
-void consult_batch(const float* live_T,       // [K*T]
+// Returns 0 on success, nonzero on bad arguments / allocation failure —
+// the caller must NOT read the output buffers then (a silent return would
+// read as "no dependencies", a correctness failure, not a crash).
+int consult_batch(const float* live_T,        // [K*T]
                    const float* key_T,        // [K*T]
                    const int32_t* ts,         // [T*lanes]
                    const int32_t* txn_id,     // [T*lanes]
@@ -63,7 +66,9 @@ void consult_batch(const float* live_T,       // [K*T]
                    uint8_t want_max,
                    uint8_t* out_deps,
                    int64_t* out_max) {
+    if (lanes > 8 || lanes <= 0 || T <= 0) return 1;  // best[8] bound below
     int8_t* share_full = static_cast<int8_t*>(std::malloc(2 * (size_t)T));
+    if (share_full == nullptr) return 2;
     int8_t* share_live = share_full + T;
     for (int32_t b = 0; b < B; ++b) {
         const int32_t* cols = qcols + (int64_t)b * max_q;
@@ -121,6 +126,7 @@ void consult_batch(const float* live_T,       // [K*T]
         }
     }
     std::free(share_full);
+    return 0;
 }
 
 }  // extern "C"

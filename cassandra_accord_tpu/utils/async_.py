@@ -18,6 +18,8 @@ from __future__ import annotations
 import traceback
 from typing import Any, Callable, Generic, List, Optional, Tuple, TypeVar
 
+from .invariants import InvariantViolation
+
 T = TypeVar("T")
 U = TypeVar("U")
 
@@ -141,6 +143,13 @@ class _Deferred(AsyncChain[T]):
         def run():
             try:
                 v = self._fn()
+            except InvariantViolation:
+                # paranoia-check failures must FAIL the run loudly, not be
+                # converted into a failure reply the protocol will retry —
+                # a broken invariant inside a message handler otherwise
+                # becomes an infinite recovery livelock (the round-5 deps
+                # parity violation burned exactly this way)
+                raise
             except BaseException as e:  # noqa: BLE001
                 callback(None, e)
                 return
