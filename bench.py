@@ -26,6 +26,7 @@ Prints ONE JSON line.
 import json
 import os
 import time
+from typing import Optional
 
 import numpy as np
 
@@ -122,6 +123,7 @@ def make_host_tier(key_inc, ts, txn_id, kind, status, active):
     from cassandra_accord_tpu.impl.tpu_resolver import TpuDepsResolver
     r = TpuDepsResolver.__new__(TpuDepsResolver)   # host tier needs only _h
     r.host_consults = 0
+    r._host_engine = "numpy"   # bare instance: skip the native-engine probe
     # no covered bits in the synthetic index: live == full incidence
     r._h = {"key_inc": key_inc, "key_inc_f32": key_inc.T.astype(np.float32),
             "live_f32": key_inc.T.astype(np.float32),
@@ -269,100 +271,225 @@ def _strip_axon_and_go_cpu():
               os.environ)
 
 
-def bench_trace_replay(device: bool):
-    """The trace-driven data-plane bench (VERDICT r03 item 1a): record the
-    FULL consult stream of a contended burn — every registration, prune,
-    durability-gate advance, delivery-window prefetch, and query exactly as
-    the protocol issued them — then replay N identity-rebased copies into one
-    resolver so the index reaches data-plane scale, under each execution
-    tier.  Protocol semantics, device engaged, sampled parity vs the cfk
-    oracle on the same state."""
-    from cassandra_accord_tpu.harness.consult_trace import (record_burn,
-                                                            scaled_replay)
-    # persistent f32 host-tier mirrors at replay scale: the honest host
-    # baseline should not pay per-call casts (memory is plentiful host-side)
-    os.environ["ACCORD_TPU_F32_MAX"] = str(1 << 20)
-    rec = record_burn(seed=PROTO_SEED, ops=PROTO_OPS, concurrency=PROTO_CONC,
-                      batch_window_us=TPU_WINDOW_US, **PROTO_KW)
-    tiers = ["walk", "host"] + (["device", "auto"] if device else [])
-    out = {}
-    for t_target in (4096, 32768):
-        out[f"T{t_target}"] = scaled_replay(rec, t_target, tiers,
-                                            parity_sample=500)
-    return out
+# ---------------------------------------------------------------------------
+# fail-open staging: the bench NEVER exits without printing its JSON line.
+# Every completed stage lands in RESULT immediately; SIGTERM/SIGALRM (the
+# driver's timeout) triggers the emit of everything finished so far
+# (VERDICT r04: a single print at the end turned a timeout into an empty
+# artifact — rc 124, no numbers at all).
+# ---------------------------------------------------------------------------
+
+import signal
+
+RESULT = {
+    "metric": "consult_replay_commits_equiv_per_sec_T32k",
+    "value": None,
+    "unit": "commits-equiv/s",
+    "vs_baseline": None,
+    "detail": {"stages": {}, "incomplete": True},
+}
+_EMITTED = False
+DEADLINE = time.monotonic() + float(os.environ.get("ACCORD_BENCH_DEADLINE_S",
+                                                   "1500"))
+
+
+def _finalize_headline():
+    """Compute the headline from whatever replay stages completed: the
+    fastest engaged tier vs the scalar cfk walk at the LARGEST completed T."""
+    d = RESULT["detail"]
+    replay = d.get("trace_replay") or {}
+    for key in sorted(replay, key=lambda k: -int(k[1:])):
+        tiers = replay[key].get("tiers") or {}
+        walk = (tiers.get("walk") or {}).get("commits_equiv_per_sec")
+        rates = {t: v.get("commits_equiv_per_sec") for t, v in tiers.items()
+                 if v.get("commits_equiv_per_sec")}
+        if not rates:
+            continue
+        # headline = the PRODUCTION tier choice: auto (the shipped cost
+        # model) when measured, else the fastest tier that ran
+        best_tier = "auto" if rates.get("auto") else max(rates, key=rates.get)
+        RESULT["value"] = rates[best_tier]
+        RESULT["metric"] = f"consult_replay_commits_equiv_per_sec_{key}"
+        d["headline_tier"] = best_tier
+        d["headline_T"] = key
+        if walk:
+            RESULT["vs_baseline"] = round(rates[best_tier] / walk, 3)
+        return
+    # no replay completed: fall back to the end-to-end protocol ratio
+    proto = d.get("protocol_end_to_end")
+    if proto and proto.get("commits_per_sec_tpu_dataplane"):
+        RESULT["metric"] = "protocol_commits_per_sec"
+        RESULT["unit"] = "commits/s"
+        RESULT["value"] = proto["commits_per_sec_tpu_dataplane"]
+        RESULT["vs_baseline"] = proto.get("ratio")
+
+
+def emit_and_exit(code=0):
+    global _EMITTED
+    if _EMITTED:
+        os._exit(code)
+    _EMITTED = True
+    _finalize_headline()
+    print(json.dumps(RESULT), flush=True)
+    os._exit(code)
+
+
+def _on_term(signum, frame):
+    RESULT["detail"]["killed_by"] = signal.Signals(signum).name
+    emit_and_exit(0)
+
+
+def stage(name: str, fn, budget_s: Optional[float] = None):
+    """Run one bench stage; record wall/errors; never raise.  Skips (with a
+    reason) once the global deadline leaves no room."""
+    stages = RESULT["detail"]["stages"]
+    left = DEADLINE - time.monotonic()
+    if left <= 30:
+        stages[name] = {"skipped": f"deadline ({left:.0f}s left)"}
+        return None
+    t0 = time.monotonic()
+    try:
+        out = fn()
+        stages[name] = {"seconds": round(time.monotonic() - t0, 1)}
+        return out
+    except Exception as e:  # noqa: BLE001 — a failed stage must not kill the rest
+        stages[name] = {"seconds": round(time.monotonic() - t0, 1),
+                        "error": f"{type(e).__name__}: {e}"[:300]}
+        return None
 
 
 def main():
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGALRM, _on_term)
+    # hard backstop 60s before any external timeout budget we were given
+    signal.alarm(max(60, int(DEADLINE - time.monotonic()) - 60))
+    d = RESULT["detail"]
+    d["north_star"] = (
+        "BASELINE.md targets 10x conflicting-txn commit throughput at deps "
+        "parity.  Where it stands: the round-5 flat-cost redesign (per-txn "
+        "universal-durability elision + hot/cold demotion) bounds EVERY "
+        "tier's per-query work at O(concurrency) — including the reference-"
+        "shaped scalar walk, which therefore now wins at protocol index "
+        "scales; the production cost model (tier=auto) routes accordingly, "
+        "and the per-op protocol cost is flat with history "
+        "(per_op_cost_flatness below, the prerequisite no tier could buy "
+        "while deps grew O(history)).  The MXU device tier's domain is the "
+        "regimes the host cannot touch: batched wide-key range joins and "
+        "huge live indexes (kernel_scaling: fused consult at T=65k, "
+        "1k-key joins; graph_kernels: closure/SCC/frontier at T=8k) — and "
+        "it now also serves live protocol-semantics streams "
+        "(trace_replay tier=device, engaged for the first time this round), "
+        "where per-launch tunnel latency at small windows is the measured "
+        "cost to amortize.")
+
     device = probe_device()
     if not device:
         _strip_axon_and_go_cpu()
-    # the protocol stages must not touch the chip IN-PROCESS: an axon tunnel
-    # that wedges AFTER the upfront probe blocks inside native code with no
-    # way to time out, killing the whole bench.  The tier threshold is what
-    # the resolver would calibrate anyway at burn-scale indexes (device
-    # dispatch never amortizes there — BENCH_r03 telemetry), so pin it and
-    # keep the chip usage in the probed/faulted stages below.
+    d["device_present"] = device
+    # protocol stages never touch the chip in-process: a wedged axon tunnel
+    # blocks inside native code un-interruptibly (BENCH_r04 post-mortem)
     os.environ.setdefault("ACCORD_TPU_DISPATCH_ELEMS", "1e13")
-    # warm the jit caches so protocol timing measures steady state, not compiles
-    bench_protocol("tpu", batch_window_us=TPU_WINDOW_US, ops=40, reps=1)
-    tpu_cps, tpu_res = bench_protocol("tpu", batch_window_us=TPU_WINDOW_US)
-    cpu_cps, cpu_res = bench_protocol("cpu", batch_window_us=0)
-    assert tpu_res.ops_ok == cpu_res.ops_ok, "workload mismatch"
-    tel = {k: v for k, v in tpu_res.stats.items() if k.startswith("resolver_")}
-    # RE-probe before each device-touching stage: the tunnel can wedge
-    # mid-run; a stage that would hang un-interruptibly is skipped instead
-    device = device and probe_device(timeout_s=60)
-    replay = bench_trace_replay(device)
-    kernels = []
-    graph = None
+
+    def proto():
+        # one warm rep (jit caches), then ONE timed rep per data plane
+        bench_protocol("tpu", batch_window_us=TPU_WINDOW_US, ops=40, reps=1)
+        tpu_cps, tpu_res = bench_protocol("tpu", batch_window_us=TPU_WINDOW_US,
+                                          reps=1)
+        cpu_cps, cpu_res = bench_protocol("cpu", batch_window_us=0, reps=1)
+        tel = {k: v for k, v in tpu_res.stats.items()
+               if k.startswith("resolver_")}
+        mismatch = tpu_res.ops_ok != cpu_res.ops_ok
+        # flat-cost check (VERDICT r05 item 2): commits/s at 200 vs 1200 ops
+        short_cps, _ = bench_protocol("cpu", batch_window_us=0, ops=200,
+                                      reps=1)
+        d["protocol_end_to_end"] = {
+            "commits_per_sec_tpu_dataplane": round(tpu_cps, 1),
+            "commits_per_sec_cpu_resolver": round(cpu_cps, 1),
+            "ratio": None if mismatch else round(tpu_cps / cpu_cps, 3),
+            "workload_mismatch": {"tpu_ops_ok": tpu_res.ops_ok,
+                                  "cpu_ops_ok": cpu_res.ops_ok}
+            if mismatch else None,
+            "commits_per_sec_cpu_at_200_ops": round(short_cps, 1),
+            "per_op_cost_flatness_1200_vs_200":
+                round(cpu_cps / short_cps, 3) if short_cps else None,
+            "workload": {"ops": PROTO_OPS, "concurrency": PROTO_CONC,
+                         **PROTO_KW, "seed": PROTO_SEED,
+                         "tpu_batch_window_us": TPU_WINDOW_US},
+            "tpu_resolver_telemetry": tel,
+        }
+    stage("protocol", proto)
+
+    def frontier():
+        # frontier-driven execution in the flagship configuration
+        from cassandra_accord_tpu.harness.burn import run_burn
+        t0 = time.perf_counter()
+        res = run_burn(seed=PROTO_SEED, ops=400, concurrency=PROTO_CONC,
+                       resolver="tpu", batch_window_us=TPU_WINDOW_US,
+                       frontier_exec=True, **PROTO_KW)
+        dt = time.perf_counter() - t0
+        d["frontier_exec"] = {
+            "commits_per_sec": round(res.ops_ok / dt, 1),
+            "ops": 400,
+            "frontier_stats": {k: v for k, v in res.stats.items()
+                               if "frontier" in k or "exec" in k},
+        }
+    stage("frontier_exec", frontier)
+
+    def record():
+        from cassandra_accord_tpu.harness.consult_trace import record_burn
+        os.environ["ACCORD_TPU_F32_MAX"] = str(1 << 20)
+        return record_burn(seed=PROTO_SEED, ops=PROTO_OPS,
+                           concurrency=PROTO_CONC,
+                           batch_window_us=TPU_WINDOW_US, **PROTO_KW)
+    rec = stage("record_burn", record)
+
+    if rec is not None:
+        from cassandra_accord_tpu.harness.consult_trace import scaled_replay
+        d["trace_replay"] = {}
+        for t_target in (4096, 32768):
+            # re-probe: the tunnel can wedge mid-run; skip rather than hang
+            dev_now = device and probe_device(timeout_s=60)
+            tiers = ["walk", "host", "auto"] + (["device"] if dev_now else [])
+
+            def replay(t_target=t_target, tiers=tiers):
+                # walk tier: ~300 sampled queries, extrapolated; device tier:
+                # bounded PREFIX replay (per-launch tunnel latency makes a
+                # full per-window replay hours — honest per-query rates on
+                # what runs, labeled truncated).  Neither may blow the budget
+                # (VERDICT r04 item 1b).
+                return scaled_replay(rec, t_target, tiers, parity_sample=500,
+                                     walk_sample_target=300,
+                                     tier_max_seconds={"device": 90.0,
+                                                       "host": 240.0,
+                                                       "auto": 240.0})
+            r = stage(f"replay_T{t_target}", replay)
+            if r is not None:
+                d["trace_replay"][f"T{t_target}"] = r
+                _finalize_headline()   # refresh headline after every stage
+
+    def kernels():
+        out = [bench_kernel(4096), bench_kernel(65536),
+               bench_kernel(65536, packed=True),
+               # BASELINE config 4: range txns, 1k keys/txn wide join
+               bench_kernel(65536, k=2048, b=64, keys_per_txn=1024,
+                            packed=True)]
+        # MFU for the consult kernel: achieved matmul FLOP/s over the chip's
+        # peak (bf16 ~275 TFLOP/s less one v5p-class chip; report both)
+        for k in out:
+            k["consult_mfu_vs_275tflops"] = round(
+                k["device_join_tflops"] / 275.0, 5)
+        return out
+
     if device and probe_device(timeout_s=60):
-        kernels = [
-            bench_kernel(4096),
-            bench_kernel(65536),
-            bench_kernel(65536, packed=True),                 # 8x less transfer
-            # BASELINE config 4: multi-key range txns, 1k keys/txn wide join
-            bench_kernel(65536, k=2048, b=64, keys_per_txn=1024, packed=True),
-        ]
-        graph = bench_graph()                                 # BASELINE config 5
-    # headline: protocol-semantics consult traffic at data-plane scale, the
-    # fastest engaged tier at T=32k vs the scalar cfk walk on the SAME stream
-    big = replay["T32768"]["tiers"]
-    walk_ce = big["walk"]["commits_equiv_per_sec"] or 1.0
-    best_tier = max((t for t in big if t != "walk"),
-                    key=lambda t: big[t]["commits_equiv_per_sec"] or 0.0)
-    best_ce = big[best_tier]["commits_equiv_per_sec"] or 0.0
-    print(json.dumps({
-        "metric": "consult_replay_commits_equiv_per_sec_T32k",
-        "value": round(best_ce, 1),
-        "unit": "commits-equiv/s",
-        "vs_baseline": round(best_ce / walk_ce, 3),
-        "detail": {
-            "baseline": "the scalar per-key cfk walk (the reference "
-                        "algorithm's shape) replaying the SAME recorded "
-                        "protocol consult stream on the same shell state",
-            "headline_tier": best_tier,
-            "device_present": device,
-            "trace_replay": replay,
-            "north_star": "BASELINE.md targets 10x conflicting-txn commit "
-                          "throughput at deps parity; this bench replays "
-                          "REAL protocol consult streams (not synthetic "
-                          "arrays) at T in {4k, 32k} — see trace_replay for "
-                          "where each tier stands and kernel_scaling for raw "
-                          "MXU rates; the end-to-end sim remains Python-"
-                          "control-plane-bound (see protocol_end_to_end)",
-            "protocol_end_to_end": {
-                "commits_per_sec_tpu_dataplane": round(tpu_cps, 1),
-                "commits_per_sec_cpu_resolver": round(cpu_cps, 1),
-                "ratio": round(tpu_cps / cpu_cps, 3),
-                "workload": {"ops": PROTO_OPS, "concurrency": PROTO_CONC,
-                             **PROTO_KW, "seed": PROTO_SEED,
-                             "tpu_batch_window_us": TPU_WINDOW_US},
-                "tpu_resolver_telemetry": tel,
-            },
-            "kernel_scaling": kernels,
-            "graph_kernels": graph,
-        },
-    }))
+        k = stage("kernel_scaling", kernels)
+        if k is not None:
+            d["kernel_scaling"] = k
+        g = stage("graph_kernels", bench_graph)   # BASELINE config 5
+        if g is not None:
+            d["graph_kernels"] = g
+
+    d["incomplete"] = False
+    emit_and_exit(0)
 
 
 if __name__ == "__main__":

@@ -96,8 +96,19 @@ class _CoordinateSyncPoint(_CoordinateTransaction):
         if tracker.has_fast_path_accepted() and self.txn_id.kind is TxnKind.SYNC_POINT:
             self.execute(ExecutePath.FAST, self.txn_id.as_timestamp(), deps)
         else:
+            # sync points agree DEPS, never a bumped executeAt
+            # (CoordinateSyncPoint.java): a fence's whole meaning is
+            # "everything before txnId".  Proposing the merged witnessed_at
+            # instead made the ACCEPT/recovery rounds recompute deps at the
+            # HIGHER bound, pulling in later-started sync points — an
+            # earlier fence then waited on a later one, which (correctly)
+            # waited back on it: the wait-cycle anchor of the PRE_APPLIED
+            # livelock class.  The epoch still extends to the witnessed
+            # epoch so scopes cover churn.
             self.extend_to_epoch(
-                execute_at, lambda: self.propose(_Ballot.ZERO, execute_at, deps))
+                execute_at,
+                lambda: self.propose(_Ballot.ZERO,
+                                     self.txn_id.as_timestamp(), deps))
 
     def merge_accept_deps(self, deps, accept_oks):
         return deps

@@ -26,7 +26,23 @@ class TopologyRandomizer:
 
     def maybe_update_topology(self) -> Optional[Topology]:
         """Apply one random mutation; returns the new topology (or None if the
-        chosen mutation was not applicable)."""
+        chosen mutation was not applicable).
+
+        Gated on outstanding bootstraps, matching the reference
+        (TopologyRandomizer.java:434 ``pendingTopologies() > 5 -> skip``):
+        un-gated churn outruns bootstrap completion and drives the cluster
+        into a pending-bootstrap blanket — every replica's copy of most keys
+        pending, reads unable to assemble coverage from any union, and the
+        bootstrap fences those reads gate stuck behind them.  The reference
+        never exercises that regime; neither should the harness."""
+        # distinct pending ranges cluster-wide ~ topologies in flight (one
+        # mutation bootstraps 1-2 distinct ranges across its replicas) —
+        # counting per-store pieces over-gates by ~replication factor
+        pending = {rng for node in self.cluster.nodes.values()
+                   for cs in node.command_stores.all_stores()
+                   for rng in (cs.pending_bootstrap or ())}
+        if len(pending) > 5:
+            return None
         current = self.cluster.topologies[-1]
         mutation = self.rng.pick(["move", "move", "split", "merge"])
         shards = list(current.shards)

@@ -207,6 +207,17 @@ class SimpleProgressLog(ProgressLog):
                     command.save_status.ordinal >= SaveStatus.APPLIED.ordinal):
                 self._done(txn_id)
                 continue
+            if command is not None \
+                    and command.save_status.ordinal >= SaveStatus.PRE_APPLIED.ordinal:
+                # the OUTCOME is already known locally: nothing to recover —
+                # the txn is waiting on its deps' applies, which the blocked-
+                # dep machinery drives.  Launching recoveries here is what
+                # starves applies behind recovery churn (the PRE_APPLIED-
+                # backlog livelock class: each recovery preempts coordinators
+                # actually draining the chain; the reference's ladder gates
+                # investigation while a txn is advancing,
+                # SimpleProgressLog.java:228-340)
+                continue
             local_token = None if command is None else ProgressToken(
                 command.durability, command.save_status.ordinal, command.promised)
             if state.token is None or (local_token is not None

@@ -212,6 +212,8 @@ class TpuDepsResolver(DepsResolver):
         # oracle itself) beats the vectorized tiers' fixed overhead — the
         # third rung of the cost ladder: walk / host-vector / MXU
         self._walk_max = int(os.environ.get("ACCORD_TPU_WALK_MAX", "384"))
+        # narrow-query walk routing past _walk_max (flat-cost walks)
+        self._walk_width = int(os.environ.get("ACCORD_TPU_WALK_WIDTH", "8"))
         # above this capacity the persistent f32 host-tier mirrors (2 × K×T×4
         # bytes) are not worth their memory — the canonical index stays int8
         # (2 × T×K bytes) and the host tier casts per call (rare: the cost
@@ -416,6 +418,11 @@ class TpuDepsResolver(DepsResolver):
         m = self.txns.get(txn_id)
         if m is None:
             return
+        if not m.durable:
+            # the flag changes per-bound answers (the walk/_slow_hits flag
+            # path) even when no covered bit flips here — cached window
+            # answers computed before it are unservable
+            self._cache = None
         m.durable = True
         self._dirty_txns.add(txn_id)   # h["durable"] row updates on flush
         committed_i, invalidated_i = _status_codes()
@@ -526,9 +533,12 @@ class TpuDepsResolver(DepsResolver):
         Specs whose bound is at/below a queried key's covering bound take the
         exact per-key slow path instead of the batched matmul."""
         self._maybe_resweep_durable()   # BEFORE the cache is built
-        if self._use_walk():
-            # below the vectorization threshold the walk answers each query
-            # cheaper than a batch pass + cache bookkeeping
+        widest = max((len(s.keys) for s in specs), default=0)
+        if self._use_walk(width=widest):
+            # below the vectorization threshold — or a window of uniformly
+            # narrow queries against a big index, where per-query walks beat
+            # a dense batch pass — the walk answers each query cheaper than
+            # batch + cache bookkeeping
             self._cache = None
             return
         self._cache = {}
@@ -720,9 +730,16 @@ class TpuDepsResolver(DepsResolver):
                     ready_ids.add(self.txn_at[s])
         return ready_ids
 
-    def _use_walk(self) -> bool:
+    def _use_walk(self, width: Optional[int] = None) -> bool:
         if self.tier == "auto":
-            return len(self.txns) <= self._walk_max
+            if len(self.txns) <= self._walk_max:
+                return True
+            # the flat-cost redesign (cold-tier demotion) makes the scalar
+            # cfk walk O(hot-set) per key REGARDLESS of index size: narrow
+            # queries always walk; only wide footprints amortize a dense
+            # O(T*K) vectorized pass (measured: at T=65k the dense host pass
+            # collapses to ~60 q/s while the walk holds thousands)
+            return width is not None and width <= self._walk_width
         return self.tier == "walk"
 
     def _walk_tier(self) -> DepsResolver:
@@ -748,7 +765,7 @@ class TpuDepsResolver(DepsResolver):
             # CpuDepsResolver.key_conflicts) — the covered bits bake it in,
             # so sync points always take the exact walk
             return self._walk_tier().key_conflicts(by, keys, before)
-        if self._use_walk():
+        if self._use_walk(width=len(known)):
             return self._walk_tier().key_conflicts(by, keys, before)
         hit, ans, delta = self._cached(("kc", by, frozenset(known), before),
                                        known, by, before)
@@ -798,7 +815,7 @@ class TpuDepsResolver(DepsResolver):
         known = [rk for rk in keys if rk in self.key_slot]
         if not known or not self.txns:
             return floor
-        if self._use_walk():
+        if self._use_walk(width=len(known)):
             # the walk tier (cfk) carries its own pruned floor already
             return self._walk_tier().max_conflict_keys(keys)
         hit, ans, delta = self._cached(("mc", frozenset(known)), known, None,

@@ -46,6 +46,47 @@ class AgentExecutor:
         return au.of_callable(task, executor=self)
 
 
+def command_footprint(cmd):
+    """A command's key footprint: its partial txn's keys, else its route
+    participants (may be Keys-like or Ranges).  Single definition shared by
+    the live evidence scan and CommandSummary so the two can never drift."""
+    if cmd.partial_txn is not None:
+        return cmd.partial_txn.keys
+    if cmd.route is not None:
+        return cmd.route.participants()
+    return None
+
+
+class _SummaryDeps:
+    """Minimal partial_deps stand-in for CommandSummary (contains only)."""
+    __slots__ = ("ids",)
+
+    def __init__(self, ids: frozenset):
+        self.ids = ids
+
+    def contains(self, txn_id) -> bool:
+        return txn_id in self.ids
+
+
+class CommandSummary:
+    """Evidence-grade snapshot of a TERMINAL evicted command
+    (impl/CommandsSummary.java): everything recovery_evidence reads —
+    status lattice position, executeAt, deps membership, footprint — without
+    a journal decode.  Terminal commands never change while cold, so the
+    snapshot taken at evict time stays exact until fault-in discards it."""
+    __slots__ = ("txn_id", "status", "save_status", "execute_at",
+                 "partial_deps", "footprint")
+
+    def __init__(self, cmd) -> None:
+        self.txn_id = cmd.txn_id
+        self.status = cmd.status
+        self.save_status = cmd.save_status
+        self.execute_at = cmd.execute_at
+        self.partial_deps = None if cmd.partial_deps is None \
+            else _SummaryDeps(frozenset(cmd.partial_deps.txn_ids()))
+        self.footprint = command_footprint(cmd)
+
+
 class CommandStore:
     """One metadata shard of one node."""
 
@@ -83,6 +124,12 @@ class CommandStore:
         # cache-miss injection): ids whose command state was EVICTED from
         # memory and lives only in the journal; faulted back in on access
         self.cold: set = set()
+        # evidence-grade snapshots of evicted TERMINAL commands (the
+        # reference's CommandsSummary): recovery evidence scans answer from
+        # these instead of faulting the whole cold set through the journal
+        # codec on every BeginRecovery (the seed-6 wall-clock storm: 125k+
+        # fault-ins from repeated evidence scans at quiesce)
+        self.cold_summaries: Dict[TxnId, "CommandSummary"] = {}
         # cold-GC memo: cold id -> the (redundant, majority, universal, shard)
         # max bounds it was last evaluated under; re-fault only on advance
         self.cold_gc_seen: dict = {}
@@ -140,6 +187,7 @@ class CommandStore:
         loads; reloads here are synchronous, with the interleaving dimension
         exercised by DelayedAgentExecutor's deferred store tasks)."""
         self.cold.discard(txn_id)
+        self.cold_summaries.pop(txn_id, None)
         cmd = self.journal.reconstruct_one(self, txn_id) \
             if self.journal is not None else None
         if cmd is not None:
@@ -270,6 +318,7 @@ class SafeCommandStore:
             return False
         del store.commands[txn_id]
         store.cold.add(txn_id)
+        store.cold_summaries[txn_id] = CommandSummary(cmd)
         store.journal.on_evict(store, txn_id)
         return True
 

@@ -367,8 +367,16 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
             break
         min_fence = f if min_fence is None or f < min_fence else min_fence
     min_fence = min_fence if have_fence else None
+    awaits_only = command.txn_id.awaits_only_deps
     for dep_id in deps.txn_ids():
         if dep_id == command.txn_id:
+            continue
+        if awaits_only and command.txn_id < dep_id:
+            # fences (exclusive sync points) take deps only on LOWER ids: a
+            # higher-id dep is structurally impossible and waiting on one
+            # builds a cycle with the later fence that (correctly) waits on
+            # us — defense in depth against any deps path that computed at a
+            # bound above txnId
             continue
         # removeRedundantDependencies (Commands.java:704-705): deps below the
         # locally-redundant bound have applied (or are subsumed by bootstrap)

@@ -131,13 +131,10 @@ class RecoverNack(Reply):
 # ---------------------------------------------------------------------------
 
 def _footprint(command: "Command"):
-    """A command's key footprint: its partial txn's keys, else its route
-    participants (may be Keys-like or Ranges)."""
-    if command.partial_txn is not None:
-        return command.partial_txn.keys
-    if command.route is not None:
-        return command.route.participants()
-    return None
+    """A command's key footprint (shared definition — see
+    command_store.command_footprint; CommandSummary snapshots the same)."""
+    from ..local.command_store import command_footprint
+    return command_footprint(command)
 
 
 def _routing_set(keys) -> Optional[Set]:
@@ -188,15 +185,22 @@ def _scan_conflicting(safe_store: SafeCommandStore, txn_id: TxnId, keys):
     """Yield (command, footprint) for every other command conflicting with ``keys``
     whose kind would witness ours (the mapReduceFull scan; the reference indexes
     this via cfk, we scan the command map — recovery is rare)."""
-    # fault evicted commands back in: the evidence scan must see EVERY
-    # conflicting txn, memory-resident or not (cache-miss plane).  The
-    # journaled ROUTE is peeked first — only commands whose footprint can
-    # intersect pay the full command decode (route.participants() is a
-    # superset of the txn-keys footprint, so the filter is conservative)
+    # evicted commands answer from their CommandSummary (snapshotted at evict
+    # time — terminal, so exact): the evidence scan must see EVERY conflicting
+    # txn, memory-resident or not, but repeated scans must NOT re-decode the
+    # whole cold set through the journal each time (BeginRecovery churn at
+    # quiesce ran 125k+ fault-ins).  Summary-less cold ids (none in practice)
+    # take the old peek-route + fault-in path.
     store = safe_store.store
     journal = store.journal
     for cold_id in list(store.cold):
         if cold_id == txn_id or not txn_id.witnessed_by(cold_id.kind):
+            continue
+        summary = store.cold_summaries.get(cold_id)
+        if summary is not None:
+            if summary.footprint is not None \
+                    and _intersects(keys, summary.footprint):
+                yield summary, summary.footprint
             continue
         if journal is not None:
             route = journal.peek_route(store, cold_id)

@@ -92,6 +92,9 @@ def test_engages_on_protocol_burn(monkeypatch):
     """A burn above the walk tier must route sparse consults to the native
     engine and stay green (parity with the walk asserted by resolver=verify)."""
     monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")
+    # the narrow-query walk routing would (correctly) claim these sparse
+    # consults in production; pin it off to keep the native engine under test
+    monkeypatch.setenv("ACCORD_TPU_WALK_WIDTH", "0")
     from cassandra_accord_tpu.harness.burn import run_burn
     result = run_burn(seed=511, ops=60, concurrency=8, resolver="verify")
     assert result.ops_ok == 60

@@ -61,7 +61,8 @@ def make_pair():
     store = _FakeStore()
     cpu = CpuDepsResolver(store)
     tpu = TpuDepsResolver(store, txn_capacity=4, key_capacity=4)  # force growth
-    tpu._walk_max = 0   # keep the vector tiers under test (not the walk rung)
+    tpu._walk_max = 0    # keep the vector tiers under test (not the walk rung)
+    tpu._walk_width = 0  # and disable the narrow-query walk routing too
     return store, VerifyDepsResolver(cpu, tpu)
 
 
@@ -187,7 +188,8 @@ def test_witness_matrix_parity():
 
 def test_cluster_end_to_end_verify_resolver(monkeypatch):
     """A full simulated-cluster run with the parity-asserting resolver."""
-    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")   # exercise vector tiers
+    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")
+    monkeypatch.setenv("ACCORD_TPU_WALK_WIDTH", "0")   # exercise vector tiers
     shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
     cluster = Cluster(Topology(1, shards), seed=77, resolver="verify")
     results = []
@@ -211,7 +213,8 @@ def test_cluster_end_to_end_verify_resolver(monkeypatch):
 
 def test_burn_with_verify_resolver(monkeypatch):
     """Seeded burn (topology churn + journal) under continuous deps parity."""
-    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")   # exercise vector tiers
+    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")
+    monkeypatch.setenv("ACCORD_TPU_WALK_WIDTH", "0")   # exercise vector tiers
     result = run_burn(seed=424242, ops=80, concurrency=8, topology_churn=True,
                       journal=True, resolver="verify")
     assert result.ops_ok > 0
@@ -398,7 +401,8 @@ def test_cluster_batch_window_parity(monkeypatch):
     """Delivery-window coalescing under the parity-asserting resolver: the
     batched/prefetched fast path must agree with the cfk walk on every query,
     and actually hit."""
-    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")   # exercise vector tiers
+    monkeypatch.setenv("ACCORD_TPU_WALK_MAX", "0")
+    monkeypatch.setenv("ACCORD_TPU_WALK_WIDTH", "0")   # exercise vector tiers
     shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
     cluster = Cluster(Topology(1, shards), seed=99, resolver="verify",
                       batch_window_us=2_000)
