@@ -339,7 +339,7 @@ def _on_term(signum, frame):
     emit_and_exit(0)
 
 
-def stage(name: str, fn, budget_s: Optional[float] = None):
+def stage(name: str, fn):
     """Run one bench stage; record wall/errors; never raise.  Skips (with a
     reason) once the global deadline leaves no room."""
     stages = RESULT["detail"]["stages"]

@@ -300,7 +300,10 @@ class _ExecuteTxn:
         rounds) on a single exhaustion."""
         if self._read_retry_pending:
             return
-        if self.read_rounds >= self.MAX_READ_ROUNDS:
+        cfg = getattr(self.node, "config", None)
+        max_rounds = cfg.max_read_rounds if cfg is not None \
+            else self.MAX_READ_ROUNDS
+        if self.read_rounds >= max_rounds:
             # NOTE: rounds exhausted partly by hard (link FAILURE) replies
             # still retry — in the chaos model link failures are transient
             # (links re-randomize every few sim-seconds), and failing the
@@ -326,7 +329,9 @@ class _ExecuteTxn:
             for to in self.read_tracker.initial_contacts(
                     prefer=self.node.id, rotate=self.read_rounds):
                 self.send_read_retry(to)
-        self.node.scheduler.once(0.15, go)
+            self._arm_read_speculation()   # retry rounds speculate too
+        delay = cfg.read_retry_delay_s if cfg is not None else 0.15
+        self.node.scheduler.once(delay, go)
 
     @property
     def needs_read(self) -> bool:
@@ -419,6 +424,25 @@ class _ExecuteTxn:
             self.stable_tracker.nodes(),
             lambda to: self.commit_for(to, read=to in read_nodes),
             self.callback)
+        if read_nodes:
+            self._arm_read_speculation()
+
+    def _arm_read_speculation(self) -> None:
+        """Slow-replica read speculation (ReadTracker.java): if any shard's
+        data read hasn't answered within the slow threshold, speculatively
+        contact the next-preferred replica — without failing the slow one.
+        The reference speculates immediately on its slow signal; waiting for
+        the failure path instead costs whole reply-timeout rounds under
+        chaos (VERDICT r04 item 3)."""
+        cfg = getattr(self.node, "config", None)
+        delay = cfg.slow_read_threshold_s if cfg is not None else 0.6
+
+        def fire():
+            if self.done:
+                return
+            for to in self.read_tracker.speculate():
+                self.send_read_retry(to)
+        self.node.scheduler.once(delay, fire)
 
     def commit_for(self, to: int, read: bool) -> Optional[Commit]:
         scope = TxnRequest.compute_scope(to, self.topologies, self.route)

@@ -190,6 +190,25 @@ class ReadTracker(AbstractTracker):
         self._contacted.update(out)
         return sorted(out)
 
+    def speculate(self) -> List[int]:
+        """Slow-replica speculation (ReadTracker.java's slow/insufficient
+        ladder): for each shard still awaiting data, contact ONE additional
+        untried replica WITHOUT failing the in-flight one — a slow replica
+        costs only the duplicate read, not a whole reply-timeout round."""
+        extra: Set[int] = set()
+        for t in self.trackers:
+            if t.data_received:
+                continue
+            candidates = [n for n in t.shard.nodes
+                          if n not in t.failures
+                          and n not in t.in_flight_reads]
+            if candidates:
+                pick = candidates[0]
+                t.in_flight_reads.add(pick)
+                extra.add(pick)
+        self._contacted.update(extra)
+        return sorted(extra)
+
     def record_read_success(self, node: int) -> RequestStatus:
         for t in self.trackers_for(node):
             if node in t.in_flight_reads:

@@ -452,6 +452,13 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         result.ops_submitted = state["submitted"]
         result.sim_micros = cluster.now_micros
         result.stats = dict(cluster.stats)
+        # per-key execution-register inversion diagnostic (TimestampsForKey):
+        # surfaced in every burn's stats; MUST be 0 in benign runs (asserted
+        # by test_timestamps_for_key) — growth under chaos pages the Agent
+        # via on_inconsistent_timestamp escalation, not silence
+        result.stats["tfk_inversions"] = sum(
+            cs.tfk_inversions for node in cluster.nodes.values()
+            for cs in node.command_stores.all_stores())
         if cache_miss:
             result.stats["cache_miss_loads"] = sum(
                 cs.cache_miss_loads for node in cluster.nodes.values()

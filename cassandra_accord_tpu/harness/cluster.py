@@ -358,7 +358,8 @@ class Cluster:
                  clock_drift: bool = False,
                  journal: bool = False,
                  resolver: Optional[str] = None,
-                 batch_window_us: int = 0):
+                 batch_window_us: int = 0,
+                 node_config=None):
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
         self.scheduler = SimScheduler(self.queue)
@@ -414,7 +415,8 @@ class Cluster:
                 num_shards=num_shards,
                 executor_factory=executor_factory,
                 progress_log_factory=plf,
-                resolver=resolver)
+                resolver=resolver,
+                config=node_config)
             if clock_drift:
                 self._start_drift(node_id)
         if journal:

@@ -440,7 +440,7 @@ def replay_stream(events: List[tuple], tier: str,
             _, by, keys, before = ev
             ans = resolver.key_conflicts(by, list(keys), before)
             q_time += time.perf_counter() - t0
-            if oracle is not None and queries % parity_sample == 0:
+            if oracle is not None and answered % parity_sample == 0:
                 expect = oracle.key_conflicts(by, list(keys), before)
                 check_state(sorted(ans) == sorted(expect),
                             "replay parity violation (kc) at event %s", i)
@@ -452,7 +452,7 @@ def replay_stream(events: List[tuple], tier: str,
         elif op == "mc":
             ans = resolver.max_conflict_keys(list(ev[1]))
             q_time += time.perf_counter() - t0
-            if oracle is not None and queries % parity_sample == 0:
+            if oracle is not None and answered % parity_sample == 0:
                 expect = oracle.max_conflict_keys(list(ev[1]))
                 check_state(ans == expect,
                             "replay parity violation (mc) at event %s", i)

@@ -191,7 +191,9 @@ class SimpleProgressLog(ProgressLog):
         staggers via randomized requeue delays, SimpleProgressLog.java)."""
         if not hasattr(self, "_stagger_rng"):
             self._stagger_rng = self.node.random.fork()
-        delay = 0.5 * self._stagger_rng.next_float()
+        window = getattr(self.node, "config", None)
+        window = window.investigation_stagger_s if window is not None else 0.5
+        delay = window * self._stagger_rng.next_float()
         self.node.scheduler.once(
             delay, lambda: self.store.execute(lambda _s: launch()))
 

@@ -24,7 +24,6 @@ rare control transactions, not data-plane load.
 """
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Tuple, TYPE_CHECKING
 
 from ..primitives.keys import Range, RoutingKey
@@ -36,22 +35,23 @@ if TYPE_CHECKING:
     from ..local.cfk import InternalStatus
 
 
-def resolver_kind_from_env() -> str:
-    kind = os.environ.get("ACCORD_RESOLVER", "cpu").lower()
+def check_resolver_kind(kind: str) -> str:
     check_state(kind in ("cpu", "tpu", "verify"),
-                "ACCORD_RESOLVER must be cpu|tpu|verify, got %s", kind)
+                "resolver kind must be cpu|tpu|verify, got %s", kind)
     return kind
 
 
-def make_resolver(kind: str, store: "CommandStore") -> "DepsResolver":
+def make_resolver(kind: str, store: "CommandStore",
+                  config=None) -> "DepsResolver":
     if kind == "cpu":
         return CpuDepsResolver(store)
     if kind == "tpu":
         from .tpu_resolver import TpuDepsResolver
-        return TpuDepsResolver(store)
+        return TpuDepsResolver(store, config=config)
     if kind == "verify":
         from .tpu_resolver import TpuDepsResolver
-        return VerifyDepsResolver(CpuDepsResolver(store), TpuDepsResolver(store))
+        return VerifyDepsResolver(CpuDepsResolver(store),
+                                  TpuDepsResolver(store, config=config))
     raise ValueError(f"unknown resolver kind {kind!r}")
 
 

@@ -73,7 +73,6 @@ when the free list empties.
 from __future__ import annotations
 
 import heapq
-import os
 import time
 from typing import Dict, List, Optional, Set, Tuple, TYPE_CHECKING
 
@@ -163,13 +162,16 @@ class _TxnMirror:
 
 class TpuDepsResolver(DepsResolver):
     def __init__(self, store: "CommandStore", txn_capacity: Optional[int] = None,
-                 key_capacity: Optional[int] = None):
+                 key_capacity: Optional[int] = None, config=None):
+        from ..config import LocalConfig
+        cfg = config if config is not None else LocalConfig.from_env()
+        self.config = cfg
         # initial capacities: growth doubles them (a host rebuild + a new jit
         # shape each time), so long-running/bench deployments start big
         if txn_capacity is None:
-            txn_capacity = int(os.environ.get("ACCORD_TPU_TXN_SLOTS", "64"))
+            txn_capacity = cfg.tpu_txn_slots
         if key_capacity is None:
-            key_capacity = int(os.environ.get("ACCORD_TPU_KEY_SLOTS", "64"))
+            key_capacity = cfg.tpu_key_slots
         self.store = store
         self.txns: Dict[TxnId, _TxnMirror] = {}
         self.txn_at: Dict[int, TxnId] = {}          # slot -> txn (attribution)
@@ -206,19 +208,19 @@ class TpuDepsResolver(DepsResolver):
         self._device = None              # device copy (lazy, synced on use)
         self._device_clean = False
         # tier selection: 'auto' cost model, or forced for tests/benches
-        self.tier = os.environ.get("ACCORD_TPU_TIER", "auto")
+        self.tier = cfg.tpu_tier
         self._threshold_elems: Optional[float] = None
         # below this many indexed txns the per-key scalar walk (the cfk
         # oracle itself) beats the vectorized tiers' fixed overhead — the
         # third rung of the cost ladder: walk / host-vector / MXU
-        self._walk_max = int(os.environ.get("ACCORD_TPU_WALK_MAX", "384"))
+        self._walk_max = cfg.tpu_walk_max
         # narrow-query walk routing past _walk_max (flat-cost walks)
-        self._walk_width = int(os.environ.get("ACCORD_TPU_WALK_WIDTH", "8"))
+        self._walk_width = cfg.tpu_walk_width
         # above this capacity the persistent f32 host-tier mirrors (2 × K×T×4
         # bytes) are not worth their memory — the canonical index stays int8
         # (2 × T×K bytes) and the host tier casts per call (rare: the cost
         # model prefers the device tier at that scale anyway)
-        self._f32_max = int(os.environ.get("ACCORD_TPU_F32_MAX", "16384"))
+        self._f32_max = cfg.tpu_f32_max
         self._walk: Optional[DepsResolver] = None
         self.walk_consults = 0
         self.host_consults = 0
@@ -227,7 +229,7 @@ class TpuDepsResolver(DepsResolver):
         # host-tier engine: 'auto' uses the native C++ consult when built and
         # the query key-counts are sparse (its O(B*T*k_q) walk beats the
         # dense BLAS pass), 'numpy'/'native' force a rung
-        self._host_engine = os.environ.get("ACCORD_TPU_HOST_TIER", "auto")
+        self._host_engine = cfg.tpu_host_engine
         # execute-phase wait-graph mirror (Commands WaitingOn edges), the input
         # to the kernel-computed execution frontier
         self.edges: Dict[TxnId, Set[TxnId]] = {}
@@ -864,9 +866,10 @@ class TpuDepsResolver(DepsResolver):
         """elems = B·T·K above which the device tier wins: calibrated once
         from a measured launch round-trip and the host tier's element rate."""
         if self._threshold_elems is None:
-            env = os.environ.get("ACCORD_TPU_DISPATCH_ELEMS")
-            if env is not None:
-                self._threshold_elems = float(env)
+            override = getattr(self, "config", None)
+            override = override.tpu_dispatch_elems if override else None
+            if override is not None:
+                self._threshold_elems = override
             else:
                 self._threshold_elems = _calibrate_threshold()
         return self._threshold_elems

@@ -153,7 +153,8 @@ class CommandStore:
         # the conflict-index data plane (impl/resolver.py): answers the deps
         # and max-conflict queries; cpu = cfk walk, tpu = device GraphState
         from ..impl.resolver import make_resolver
-        self.resolver = make_resolver(getattr(node, "resolver_kind", "cpu"), self)
+        self.resolver = make_resolver(getattr(node, "resolver_kind", "cpu"),
+                                      self, config=getattr(node, "config", None))
 
     # -- ranges -------------------------------------------------------------
     def update_ranges(self, epoch: int, ranges: Ranges) -> None:

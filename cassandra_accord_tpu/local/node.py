@@ -35,7 +35,9 @@ class Node(ConfigurationService.Listener):
                  num_shards: int = 1,
                  executor_factory: Optional[Callable[[int], AgentExecutor]] = None,
                  progress_log_factory: Optional[Callable[[object], ProgressLog]] = None,
-                 resolver: Optional[str] = None):
+                 resolver: Optional[str] = None,
+                 config=None):
+        from ..config import LocalConfig
         self.id = node_id
         self.message_sink = message_sink
         self.config_service = config_service
@@ -44,10 +46,14 @@ class Node(ConfigurationService.Listener):
         self.data_store = data_store
         self.random = random
         self._now_micros = now_micros
+        # one injected config object (config/LocalConfig.java); env vars are
+        # the default source, the object is the override surface
+        self.config: LocalConfig = config if config is not None \
+            else LocalConfig.from_env()
         # deps-resolver data plane selection (impl/resolver.py): cpu|tpu|verify
-        from ..impl.resolver import resolver_kind_from_env
-        self.resolver_kind = resolver if resolver is not None \
-            else resolver_kind_from_env()
+        from ..impl.resolver import check_resolver_kind
+        self.resolver_kind = check_resolver_kind(
+            resolver if resolver is not None else self.config.resolver_kind)
         self.topology = TopologyManager(node_id)
         self._epoch_watchdogs: set = set()
         self.command_stores = CommandStores(self, num_shards, executor_factory)
@@ -152,8 +158,6 @@ class Node(ConfigurationService.Listener):
     # give up (failing the waiters) after this many attempts — an unreachable
     # configuration service must not stall epoch-gated work forever
     # (TopologyManager.java fetch watchdog / LocalConfig epoch timeouts)
-    EPOCH_FETCH_RETRY_S = 1.0
-    EPOCH_FETCH_ATTEMPTS = 30
 
     def with_epoch(self, epoch: int) -> au.AsyncChain:
         """Await local knowledge of ``epoch`` (Node.java:289-322)."""
@@ -170,7 +174,7 @@ class Node(ConfigurationService.Listener):
             if self.topology.has_epoch(epoch):
                 self._epoch_watchdogs.discard(epoch)
                 return
-            if attempts + 1 >= self.EPOCH_FETCH_ATTEMPTS:
+            if attempts + 1 >= self.config.epoch_fetch_attempts:
                 self._epoch_watchdogs.discard(epoch)
                 from ..coordinate.errors import Timeout
                 self.topology.fail_epoch_waiters(
@@ -179,7 +183,7 @@ class Node(ConfigurationService.Listener):
                 return
             self.config_service.fetch_topology_for_epoch(epoch)
             self._arm_epoch_watchdog(epoch, attempts + 1)
-        self.scheduler.once(self.EPOCH_FETCH_RETRY_S, check)
+        self.scheduler.once(self.config.epoch_fetch_retry_s, check)
 
     # -- coordination entry points (Node.java:573+) ---------------------------
     def coordinate(self, txn: Txn, txn_id: Optional[TxnId] = None) -> au.AsyncResult:
