@@ -31,7 +31,13 @@ class LocalConfig:
     # -- coordination timing -------------------------------------------------
     read_retry_delay_s: float = 0.15        # transient-nack read re-round beat
     max_read_rounds: int = 3                # bounded re-rounds before Exhausted
-    slow_read_threshold_s: float = 0.6      # speculative second read beat
+    # speculative second read beat: sized just under the reply timeout (2s) —
+    # aggressive speculation (0.6s was tried) duplicates reads under chaos
+    # and flipped passing hostile seeds into the livelock class; at 1.5s it
+    # fires only where it saves a whole timeout round (measured: hostile
+    # seed 5 passes in 6.6s with 1.5s, 16.1s without speculation, stalls
+    # at 0.6s)
+    slow_read_threshold_s: float = 1.5
     investigation_stagger_s: float = 0.5    # progress-log launch stagger window
 
     # -- deps-resolver data plane (impl/resolver.py, impl/tpu_resolver.py) ---
@@ -45,19 +51,26 @@ class LocalConfig:
     tpu_host_engine: str = "auto"           # auto | numpy | native
     tpu_dispatch_elems: Optional[float] = None  # device-tier threshold override
 
+    _ENV_FIELDS = (
+        ("ACCORD_RESOLVER", "resolver_kind", lambda v: v.lower()),
+        ("ACCORD_TPU_TXN_SLOTS", "tpu_txn_slots", int),
+        ("ACCORD_TPU_KEY_SLOTS", "tpu_key_slots", int),
+        ("ACCORD_TPU_TIER", "tpu_tier", str),
+        ("ACCORD_TPU_WALK_MAX", "tpu_walk_max", int),
+        ("ACCORD_TPU_WALK_WIDTH", "tpu_walk_width", int),
+        ("ACCORD_TPU_F32_MAX", "tpu_f32_max", int),
+        ("ACCORD_TPU_HOST_TIER", "tpu_host_engine", str),
+        ("ACCORD_TPU_DISPATCH_ELEMS", "tpu_dispatch_elems", float),
+    )
+
     @classmethod
     def from_env(cls, **overrides) -> "LocalConfig":
-        env = os.environ
-        de = env.get("ACCORD_TPU_DISPATCH_ELEMS")
-        cfg = cls(
-            resolver_kind=env.get("ACCORD_RESOLVER", "cpu").lower(),
-            tpu_txn_slots=int(env.get("ACCORD_TPU_TXN_SLOTS", "64")),
-            tpu_key_slots=int(env.get("ACCORD_TPU_KEY_SLOTS", "64")),
-            tpu_tier=env.get("ACCORD_TPU_TIER", "auto"),
-            tpu_walk_max=int(env.get("ACCORD_TPU_WALK_MAX", "384")),
-            tpu_walk_width=int(env.get("ACCORD_TPU_WALK_WIDTH", "8")),
-            tpu_f32_max=int(env.get("ACCORD_TPU_F32_MAX", "16384")),
-            tpu_host_engine=env.get("ACCORD_TPU_HOST_TIER", "auto"),
-            tpu_dispatch_elems=float(de) if de is not None else None,
-        )
-        return replace(cfg, **overrides) if overrides else cfg
+        # kwargs ONLY for env vars actually set: the dataclass field defaults
+        # stay the single source of truth
+        kw = {}
+        for var, field, conv in cls._ENV_FIELDS:
+            raw = os.environ.get(var)
+            if raw is not None:
+                kw[field] = conv(raw)
+        kw.update(overrides)
+        return cls(**kw)

@@ -435,7 +435,7 @@ class _ExecuteTxn:
         the failure path instead costs whole reply-timeout rounds under
         chaos (VERDICT r04 item 3)."""
         cfg = getattr(self.node, "config", None)
-        delay = cfg.slow_read_threshold_s if cfg is not None else 0.6
+        delay = cfg.slow_read_threshold_s if cfg is not None else 1.5
 
         def fire():
             if self.done:

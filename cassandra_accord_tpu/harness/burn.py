@@ -258,6 +258,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                                 continue
                             if tid in ready:
                                 cs.exec_deferred.discard(tid)
+                                cluster.stats["frontier_released"] = \
+                                    cluster.stats.get("frontier_released", 0) + 1
                                 C.maybe_execute(safe, cmd, True,
                                                 from_frontier=True)
                     cs.execute(in_store)

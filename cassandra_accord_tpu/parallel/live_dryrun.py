@@ -21,23 +21,67 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 
-def collect_live_state(n_stores: int, seed: int = 7, ops: int = 60,
-                       concurrency: int = 8):
+# the arrays stack_store_indexes consumes — snapshots copy nothing else
+_FRAME_KEYS = ("live_inc", "key_inc", "ts", "txn_id", "kind", "status",
+               "active")
+
+
+def collect_live_state(n_stores: int, seed: int = 7, ops: int = 1000,
+                       concurrency: int = 16,
+                       snapshot_fracs: Tuple[float, ...] = (1 / 3, 2 / 3)):
     """Run a contended burn recording every store's consult stream; return
-    (stores, recorder) where ``stores`` are the n_stores command stores with
-    the largest live device indexes."""
+    (stores, recorder, snapshots) where ``stores`` are the n_stores command
+    stores with the largest live device indexes and ``snapshots`` are
+    MID-STREAM copies of each store's device mirrors (host arrays + key-slot
+    map + recorded-event position) captured at the given op fractions —
+    VERDICT r04 item 7: mid-stream states over the mesh, not just the final
+    index."""
     from ..harness.burn import run_burn
     from ..harness.consult_trace import ConsultRecorder
 
     rec = ConsultRecorder()
+    targets = sorted({max(1, int(ops * f)) for f in snapshot_fracs})
+    snapshots: List[Dict] = []
+
+    def snap(op_id, _txn_id, _txn, _coord) -> None:
+        if not targets or op_id != targets[0]:
+            return
+        targets.pop(0)
+        frame: Dict = {}
+        for store, events in rec.streams.items():
+            tpu = _tpu(store)
+            tpu._flush()
+            if tpu._h is None:
+                continue
+            frame[store] = {
+                "h": {k: np.array(tpu._h[k]) for k in _FRAME_KEYS},
+                "key_slot": dict(tpu.key_slot),
+                "event_pos": len(events),
+            }
+        snapshots.append(frame)
+
     # shards*nodes >= n_stores so every device can own a distinct live store;
     # few keys -> contention -> deep deps rows in the live index
     run_burn(seed, ops=ops, concurrency=concurrency, nodes=4, rf=3,
              key_count=6, num_shards=max(2, (n_stores + 3) // 4),
-             resolver="tpu", consult_recorder=rec)
+             resolver="tpu", consult_recorder=rec, on_submit=snap)
     stores = list(rec.streams.keys())
     stores.sort(key=lambda s: -len(_tpu(s).txns))
-    return stores[:n_stores], rec
+    stores = stores[:n_stores]
+    # final state as the last "snapshot" frame (live mirrors, full stream)
+    final: Dict = {}
+    for store in stores:
+        tpu = _tpu(store)
+        tpu._flush()
+        if tpu._h is None:
+            continue
+        final[store] = {
+            "h": {k: np.array(tpu._h[k]) for k in _FRAME_KEYS},
+            "key_slot": dict(tpu.key_slot),
+            "event_pos": len(rec.streams.get(store, ())),
+        }
+    snapshots.append(final)
+    return stores, rec, snapshots
 
 
 def _tpu(store):
@@ -47,11 +91,16 @@ def _tpu(store):
     return getattr(r, "tpu", r)
 
 
-def stack_store_indexes(stores) -> Dict[str, np.ndarray]:
+def stack_store_indexes(stores, frame: Dict = None) -> Dict[str, np.ndarray]:
     """Stack each store's canonical host mirror into [S, T, ...] arrays,
-    padded to the max capacity (pad rows inactive — the kernels mask)."""
+    padded to the max capacity (pad rows inactive — the kernels mask).
+    With ``frame`` (a snapshot from collect_live_state), the SNAPSHOTTED
+    mirrors are stacked instead of the live ones."""
     hs = []
     for s in stores:
+        if frame is not None:
+            hs.append(frame[s]["h"])
+            continue
         tpu = _tpu(s)
         tpu._flush()
         hs.append(tpu._h)
@@ -79,35 +128,59 @@ def stack_store_indexes(stores) -> Dict[str, np.ndarray]:
     return out
 
 
-def build_query_batches(stores, recorder, K: int,
-                        batch: int = 8) -> Tuple[np.ndarray, np.ndarray,
-                                                 np.ndarray, int]:
+def build_query_batches(stores, recorder, K: int, batch: int = 8,
+                        frame: Dict = None) -> Tuple[np.ndarray, np.ndarray,
+                                                     np.ndarray, int]:
     """Per-store [S, B, ...] query arrays from each store's RECORDED consult
-    stream (the protocol's own key_conflicts calls, replayed against the
-    final index through the final key-slot mapping).  Stores with fewer than
-    ``batch`` replayable queries pad with zero (no-key) queries."""
+    stream — MIXED ops: key_conflicts (kc), max-conflict (mc), and range
+    queries (rc, expanded to the indexed keys inside the range), replayed
+    through the key-slot mapping of the state they're asked against.  With
+    ``frame`` (a mid-stream snapshot), only events recorded BEFORE the
+    snapshot replay, against the snapshotted slots.  Stores with fewer than
+    ``batch`` replayable queries pad with zero (no-key) queries.
+
+    mc rows use a zero ``before`` bound with kind 0 — the consult kernel's
+    max tier ignores the bound (elision never applies to MaxConflicts)."""
     S = len(stores)
     q = np.zeros((S, batch, K), dtype=np.int8)
     before = np.zeros((S, batch, 5), dtype=np.int32)
     qkind = np.zeros((S, batch), dtype=np.int8)
     total_real = 0
     for i, s in enumerate(stores):
-        tpu = _tpu(s)
+        key_slot = frame[s]["key_slot"] if frame is not None \
+            else _tpu(s).key_slot
         events = recorder.streams.get(s, [])
+        if frame is not None:
+            events = events[:frame[s]["event_pos"]]
         got = 0
         # replay the LATEST queries first: they saw the most index state
         for ev in reversed(events):
             if got >= batch:
                 break
-            if ev[0] != "kc":
+            tag = ev[0]
+            if tag == "kc":
+                _t, by, keys, bound = ev
+                cols = [key_slot.get(rk) for rk in keys]
+                if any(c is None for c in cols) or not cols:
+                    continue   # keys pruned from the index since: skip
+                q[i, got, cols] = 1
+                before[i, got] = bound.pack_lanes()
+                qkind[i, got] = int(by.kind)
+            elif tag == "mc":
+                cols = [key_slot.get(rk) for rk in ev[1]]
+                if any(c is None for c in cols) or not cols:
+                    continue
+                q[i, got, cols] = 1    # before stays 0: max-tier row
+            elif tag == "rc":
+                _t, by, rng, bound = ev
+                cols = [c for rk, c in key_slot.items() if rng.contains(rk)]
+                if not cols:
+                    continue
+                q[i, got, cols] = 1
+                before[i, got] = bound.pack_lanes()
+                qkind[i, got] = int(by.kind)
+            else:
                 continue
-            _tag, by, keys, bound = ev
-            cols = [tpu.key_slot.get(rk) for rk in keys]
-            if any(c is None for c in cols) or not cols:
-                continue   # keys pruned from the index since: skip
-            q[i, got, cols] = 1
-            before[i, got] = bound.pack_lanes()
-            qkind[i, got] = int(by.kind)
             got += 1
         total_real += got
     return q, before, qkind, total_real

@@ -1,8 +1,10 @@
 """LocalConfig: one injected config object (config/LocalConfig.java parity)."""
 import subprocess
-import sys
+from pathlib import Path
 
 from cassandra_accord_tpu.config import LocalConfig
+
+REPO = str(Path(__file__).resolve().parents[1])
 
 
 def test_from_env_reads_and_overrides(monkeypatch):
@@ -44,6 +46,6 @@ def test_no_scattered_env_reads_in_protocol_code():
          "cassandra_accord_tpu/local", "cassandra_accord_tpu/coordinate",
          "cassandra_accord_tpu/messages", "cassandra_accord_tpu/impl",
          "cassandra_accord_tpu/topology", "cassandra_accord_tpu/primitives"],
-        capture_output=True, text=True, cwd="/root/repo")
+        capture_output=True, text=True, cwd=REPO)
     assert out.stdout.strip() == "", \
         f"protocol files still read os.environ: {out.stdout}"

@@ -139,7 +139,7 @@ def test_live_state_sharded_consult_parity():
 
     n = 4
     mesh = parallel.make_mesh(devices=jax.devices()[:n])
-    stores, recorder = ld.collect_live_state(n, seed=11, ops=40)
+    stores, recorder, _snaps = ld.collect_live_state(n, seed=11, ops=40)
     assert len(stores) == n
     st = ld.stack_store_indexes(stores)
     assert st["active"].any()
