@@ -40,6 +40,22 @@ class LocalConfig:
     slow_read_threshold_s: float = 1.5
     investigation_stagger_s: float = 0.5    # progress-log launch stagger window
 
+    # -- crash-restart nemesis (harness/nemesis.py) --------------------------
+    # mean sim-time between crash attempts; each tick is jittered so crashes
+    # never align with the chaos re-roll cadence
+    restart_interval_s: float = 20.0
+    restart_downtime_min_s: float = 2.0     # min sim-time a node stays down
+    restart_downtime_max_s: float = 12.0    # max sim-time a node stays down
+    restart_max_down: int = 1               # max concurrently-crashed nodes
+    # never crash a node if doing so would leave ANY shard it replicates
+    # without a live slow-path quorum (liveness floor; turning this off makes
+    # stalls expected and is only for targeted experiments)
+    restart_keep_quorum: bool = True
+
+    # -- stall watchdog (harness/watchdog.py) --------------------------------
+    stall_watchdog_interval_s: float = 5.0  # sim-time between progress checks
+    stall_watchdog_after_s: float = 120.0   # sim-time with no resolved op => dump
+
     # -- deps-resolver data plane (impl/resolver.py, impl/tpu_resolver.py) ---
     resolver_kind: str = "cpu"              # cpu | tpu | verify
     tpu_txn_slots: int = 64
@@ -52,6 +68,11 @@ class LocalConfig:
     tpu_dispatch_elems: Optional[float] = None  # device-tier threshold override
 
     _ENV_FIELDS = (
+        ("ACCORD_RESTART_INTERVAL", "restart_interval_s", float),
+        ("ACCORD_RESTART_DOWNTIME_MIN", "restart_downtime_min_s", float),
+        ("ACCORD_RESTART_DOWNTIME_MAX", "restart_downtime_max_s", float),
+        ("ACCORD_RESTART_MAX_DOWN", "restart_max_down", int),
+        ("ACCORD_STALL_WATCHDOG_AFTER", "stall_watchdog_after_s", float),
         ("ACCORD_RESOLVER", "resolver_kind", lambda v: v.lower()),
         ("ACCORD_TPU_TXN_SLOTS", "tpu_txn_slots", int),
         ("ACCORD_TPU_KEY_SLOTS", "tpu_key_slots", int),

@@ -39,6 +39,8 @@ class BurnResult:
         self.ops_nacked = 0      # durably invalidated
         self.ops_lost = 0        # resolved Lost/Truncated (outcome unknown)
         self.ops_failed = 0      # unexpected failure
+        self.crashes = 0         # nemesis node kills
+        self.restarts = 0        # journal-replay rebuilds
         self.sim_micros = 0
         self.stats: Dict[str, int] = {}
 
@@ -48,9 +50,10 @@ class BurnResult:
                 + self.ops_lost + self.ops_failed)
 
     def __repr__(self):
+        restarts = f", restarts={self.restarts}" if self.restarts else ""
         return (f"BurnResult(seed={self.seed}, ok={self.ops_ok}, "
                 f"recovered={self.ops_recovered}, nacked={self.ops_nacked}, "
-                f"lost={self.ops_lost}, failed={self.ops_failed}, "
+                f"lost={self.ops_lost}, failed={self.ops_failed}{restarts}, "
                 f"sim_ms={self.sim_micros // 1000})")
 
 
@@ -126,6 +129,9 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              batch_window_us: int = 0,
              cache_miss: bool = False,
              frontier_exec: bool = False,
+             restart_nodes: bool = False,
+             stall_watchdog_s: Optional[float] = None,
+             node_config=None,
              max_tasks: int = 20_000_000,
              tracer=None, on_submit=None, consult_recorder=None) -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation.
@@ -133,14 +139,31 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     ``chaos=True`` turns on the hostile network (randomized drops, failures,
     latency spikes, minority partitions) + client retry; the progress log is
     then mandatory for liveness and defaults on.
+
+    ``restart_nodes=True`` adds the crash-restart nemesis (harness/nemesis.py):
+    seeded node kills + journal-replay rebuilds, cadence/downtime/concurrency
+    from LocalConfig (``node_config`` or env).  Requires ``journal=True``.
+
+    ``stall_watchdog_s``: raise StallError with a full wait-graph dump after
+    this much sim-time without a resolved op (None disables).
     """
+    from ..config import LocalConfig
     rng = RandomSource(seed)
     rf = rf if rf is not None else rng.pick([3, 3, 5])
     n_nodes = nodes if nodes is not None else rng.next_int(rf, 2 * rf)
     key_count = key_count if key_count is not None else rng.next_int(5, 21)
     node_ids = list(range(1, n_nodes + 1))
     if progress_log is None:
-        progress_log = chaos
+        # recovery must be live whenever coordinators can die mid-flight
+        progress_log = chaos or restart_nodes
+    if restart_nodes:
+        assert journal, "restart_nodes requires journal=True (the restart " \
+                        "store of record)"
+        assert num_shards == 1, \
+            "restart_nodes requires num_shards=1: restart replay keys " \
+            "journal logs by store id, and multi-store range assignment " \
+            "is not stable across a restart boundary"
+    cfg = node_config if node_config is not None else LocalConfig.from_env()
 
     # shard the key space into rf-replicated ranges over the nodes
     n_ranges = max(1, n_nodes // max(1, rf // 2))
@@ -162,7 +185,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                       clock_drift=clock_drift, journal=journal,
                       resolver=resolver, progress_log=progress_log,
                       progress_poll_s=progress_poll_s,
-                      batch_window_us=batch_window_us)
+                      batch_window_us=batch_window_us,
+                      node_config=node_config)
     cluster.tracer = tracer
     if consult_recorder is not None:
         # trace-driven data-plane bench (harness/consult_trace.py): wrap every
@@ -183,19 +207,26 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         randomizer = TopologyRandomizer(cluster, rng.fork())
         churn_task = cluster.scheduler.recurring(churn_interval_s,
                                                  randomizer.maybe_update_topology)
-    durability_scheduling = []
+    durability_scheduling: Dict[int, object] = {}
     if durability:
         # scheduled durability + truncation running DURING the burn, with
         # randomized cadences (Cluster.java:429-445)
         from ..impl.durability_scheduling import CoordinateDurabilityScheduling
         shard_cycle = float(rng.next_biased_int(5, 15, 45))
         global_cycle = float(rng.next_biased_int(10, 30, 90))
-        for node in cluster.nodes.values():
+
+        def start_durability(node):
             sched = CoordinateDurabilityScheduling(
                 node, shard_cycle_time_s=shard_cycle,
                 global_cycle_time_s=global_cycle)
             sched.start()
-            durability_scheduling.append(sched)
+            durability_scheduling[node.id] = sched
+
+        for node in cluster.nodes.values():
+            start_durability(node)
+        # a restarted node gets a fresh scheduling instance (the old one's
+        # timers died with its incarnation)
+        cluster.on_restart_hooks.append(start_durability)
     cache_miss_task = None
     if cache_miss:
         # cache-miss injection (DelayedCommandStores.java:138-195 capability):
@@ -274,9 +305,39 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         return IntKey((idx * bound) // key_count)
 
     state = {"submitted": 0, "in_flight": 0}
+    # op_id -> client record; the crash-restart nemesis fails over any op
+    # whose coordinator died mid-flight (the reference burn's external client
+    # resolving a dead coordinator's silence through CheckStatus probes)
+    inflight: Dict[int, dict] = {}
 
-    def resolve(obs: Observation, kind: str, reads=None,
+    def pick_coordinator():
+        # liveness precheck WITHOUT touching the rng (keeps seeded streams
+        # stable): if every member is down at once (keep_quorum=False
+        # experiments), the redial loop below would spin at HOST level —
+        # sim time frozen, so not even the stall watchdog could fire
+        if not any(m in cluster.nodes for m in member_ids):
+            raise RuntimeError("no live member to coordinate: every member "
+                               "node is down (restart_keep_quorum=False with "
+                               "restart_max_down >= cluster size?)")
+        node_id = rng.pick(member_ids)
+        while node_id not in cluster.nodes:   # crashed: the client redials
+            node_id = rng.pick(member_ids)
+        return cluster.nodes[node_id]
+
+    def live(node):
+        """The client's connection: if this node object crashed, dial a
+        currently-live node instead."""
+        if cluster.nodes.get(node.id) is node:
+            return node
+        return pick_coordinator()
+
+    def resolve(rec: dict, kind: str, reads=None,
                 writes: Optional[dict] = None) -> None:
+        if rec["settled"]:
+            return   # e.g. probe failover and a late reply raced; first wins
+        rec["settled"] = True
+        inflight.pop(rec["op_id"], None)
+        obs = rec["obs"]
         state["in_flight"] -= 1
         now = cluster.now_micros
         if kind == "ok":
@@ -296,18 +357,28 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             result.ops_failed += 1
         submit_next()
 
-    def probe(coordinator, txn_id, route, obs, writes, attempt: int) -> None:
+    def probe(coordinator, rec: dict, attempt: int) -> None:
         """Client lost-response resolution: CheckStatus the cluster until the
         txn's fate is known (ListRequest.CheckOnResult, ListRequest.java:61-150)."""
         from ..coordinate.fetch_data import check_status_quorum
+        if rec["settled"]:
+            return
+        coordinator = live(coordinator)
+        # the prober now owns this op's resolution: if IT crashes mid-probe
+        # (its sink teardown swallows the CheckStatus callbacks, so neither
+        # reply nor failure ever fires), fail_over_orphans must match on the
+        # CURRENT prober, not the original submitter, or the op hangs forever
+        rec["coordinator"] = coordinator.id
+        txn_id, route, writes = rec["txn_id"], rec["route"], rec["writes"]
 
         def retry():
+            if rec["settled"]:
+                return
             if attempt + 1 >= MAX_PROBE_ATTEMPTS:
-                resolve(obs, "failed")
+                resolve(rec, "failed")
                 return
             cluster.scheduler.once(0.5 + rng.next_float(),
-                                   lambda: probe(coordinator, txn_id, route, obs,
-                                                 writes, attempt + 1))
+                                   lambda: probe(coordinator, rec, attempt + 1))
 
         def on_checked(merged, failure):
             if failure is not None:
@@ -315,24 +386,24 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 return
             ss = merged.save_status if merged is not None else SaveStatus.NOT_DEFINED
             if ss is SaveStatus.INVALIDATED:
-                resolve(obs, "nacked", writes=writes)
+                resolve(rec, "nacked", writes=writes)
             elif merged is not None and merged.invalid_if_undecided \
                     and not ss.has_been(Status.PRE_COMMITTED):
                 # Infer (Infer.java IfUndecided with quorum): every quorum
                 # member's majority-durability watermark passed txnId and none
                 # saw a decision — the txn provably never committed and never
                 # can (preaccept below the fence refuses): durably invalid
-                resolve(obs, "nacked", writes=writes)
+                resolve(rec, "nacked", writes=writes)
             elif ss.ordinal >= SaveStatus.APPLIED.ordinal and not ss.is_truncated:
                 reads = dict(merged.result.reads) \
                     if isinstance(merged.result, ListResult) else {}
-                resolve(obs, "recovered", reads=reads, writes=writes)
+                resolve(rec, "recovered", reads=reads, writes=writes)
             elif ss.is_truncated:
                 # durably decided and cleaned up; outcome unknowable → Lost-class
-                resolve(obs, "lost")
+                resolve(rec, "lost")
             elif not ss.has_been(Status.PRE_ACCEPTED):
                 # a quorum answered and nothing witnessed it
-                resolve(obs, "lost")
+                resolve(rec, "lost")
             else:
                 # in flight somewhere — but only SOME replica may have
                 # witnessed it, and if the home shard never did, NOTHING
@@ -382,36 +453,78 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 writes = {key: f"v{op_id}.{ki}" for ki, key in enumerate(keys)} \
                     if kind in ("write", "rw") else {}
                 txn = list_txn(reads, writes)
-            coordinator = cluster.nodes[rng.pick(member_ids)]
+            coordinator = pick_coordinator()
             txn_id = coordinator.next_txn_id(txn.kind, txn.domain)
             route = txn.to_route()
             obs = verifier.begin(cluster.now_micros)
+            rec = {"op_id": op_id, "obs": obs, "txn_id": txn_id, "route": route,
+                   "writes": dict(writes), "coordinator": coordinator.id,
+                   "settled": False}
+            inflight[op_id] = rec
             if on_submit is not None:
                 on_submit(op_id, txn_id, txn, coordinator.id)
 
-            def on_done(value, failure, obs=obs, writes=writes,
-                        coordinator=coordinator, txn_id=txn_id, route=route):
+            def on_done(value, failure, rec=rec, coordinator=coordinator):
                 if failure is None and isinstance(value, ListResult):
-                    resolve(obs, "ok", reads=dict(value.reads),
-                            writes=dict(writes))
+                    resolve(rec, "ok", reads=dict(value.reads),
+                            writes=dict(rec["writes"]))
                 elif isinstance(failure, Invalidated):
-                    resolve(obs, "nacked", writes=dict(writes))
-                elif chaos or isinstance(failure, CoordinationFailed):
+                    resolve(rec, "nacked", writes=dict(rec["writes"]))
+                elif chaos or restart_nodes \
+                        or isinstance(failure, CoordinationFailed):
                     # response lost in the chaos: resolve through the home shard
-                    probe(coordinator, txn_id, route, obs, dict(writes), 0)
+                    probe(coordinator, rec, 0)
                 else:
-                    resolve(obs, "failed")
+                    resolve(rec, "failed")
 
             coordinator.coordinate(txn, txn_id=txn_id).add_listener(on_done)
+
+    nemesis = None
+    if restart_nodes:
+        from .nemesis import RestartNemesis
+
+        def fail_over_orphans(victim: int) -> None:
+            # every unsettled op this client had submitted THROUGH the dead
+            # coordinator will never hear back (its callbacks died with the
+            # process): resolve each through home-shard probes from a live
+            # node, exactly like a lost response under chaos
+            for rec in list(inflight.values()):
+                if rec["coordinator"] == victim and not rec["settled"]:
+                    cluster.scheduler.once(
+                        0.1 + rng.next_float(),
+                        lambda rec=rec: probe(pick_coordinator(), rec, 0))
+
+        nemesis = RestartNemesis(
+            cluster, rng.fork(),
+            interval_s=cfg.restart_interval_s,
+            downtime_min_s=cfg.restart_downtime_min_s,
+            downtime_max_s=cfg.restart_downtime_max_s,
+            max_down=cfg.restart_max_down,
+            keep_quorum=cfg.restart_keep_quorum,
+            on_crash=fail_over_orphans)
+        nemesis.attach()
+    watchdog = None
+    if stall_watchdog_s is not None:
+        from .watchdog import StallWatchdog
+        watchdog = StallWatchdog(cluster, lambda: result.resolved,
+                                 stalled_after_s=stall_watchdog_s,
+                                 interval_s=cfg.stall_watchdog_interval_s)
+        watchdog.attach()
     submit_next()
 
     try:
         cluster.run_until(lambda: result.resolved >= ops, max_tasks=max_tasks)
-        # quiesce: stop chaos/churn/durability so the cluster can settle
-        # (the reference's noMoreWorkSignal, Cluster.java:470-475)
+        # quiesce: stop chaos/churn/durability/nemesis so the cluster can
+        # settle (the reference's noMoreWorkSignal, Cluster.java:470-475)
+        if watchdog is not None:
+            watchdog.cancel()   # resolved stops moving by design from here on
         if churn_task is not None:
             churn_task.cancel()
-        for sched in durability_scheduling:
+        if nemesis is not None:
+            # restore every down node BEFORE judging final state: the
+            # agreement checks need the full replica set live and caught up
+            nemesis.stop_and_restore()
+        for sched in durability_scheduling.values():
             sched.stop()
         if hasattr(cluster.link, "heal"):
             cluster.link.heal()
@@ -454,6 +567,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         result.ops_submitted = state["submitted"]
         result.sim_micros = cluster.now_micros
         result.stats = dict(cluster.stats)
+        result.crashes = cluster.stats.get("node_crashes", 0)
+        result.restarts = cluster.stats.get("node_restarts", 0)
         # per-key execution-register inversion diagnostic (TimestampsForKey):
         # surfaced in every burn's stats; MUST be 0 in benign runs (asserted
         # by test_timestamps_for_key) — growth under chaos pages the Agent
@@ -483,8 +598,11 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 f"{result!r}")
         if not allow_failures and result.ops_failed:
             raise HistoryViolation(f"{result.ops_failed} ops failed unexpectedly")
-        if not chaos and (result.ops_lost or result.ops_recovered
-                          or (not allow_failures and result.ops_nacked)):
+        if not chaos and not restart_nodes \
+                and (result.ops_lost or result.ops_recovered
+                     or (not allow_failures and result.ops_nacked)):
+            # (a crashed coordinator legitimately turns acks into
+            # probe-recovered / lost resolutions even on a benign network)
             raise HistoryViolation(
                 f"benign network must ack everything: {result!r}")
         # final replica state must agree per key across replicas covering it
@@ -568,9 +686,32 @@ def main(argv=None) -> None:
                         "default hostile matrix: the reference's hardest "
                         "regime mutates topology DURING partitions)")
     p.add_argument("--no-cache-miss", action="store_true")
+    p.add_argument("--no-restart", action="store_true",
+                   help="disable the crash-restart nemesis (node kills + "
+                        "journal-replay rebuilds are part of the default "
+                        "hostile matrix)")
+    p.add_argument("--restart-interval", type=float, default=None,
+                   help="mean sim-seconds between crash attempts "
+                        "(default: LocalConfig/ACCORD_RESTART_INTERVAL)")
+    p.add_argument("--no-watchdog", action="store_true",
+                   help="disable the stall watchdog (on stall it dumps the "
+                        "wait graph + status frontier and exits nonzero)")
+    p.add_argument("--watchdog-stall", type=float, default=None,
+                   help="sim-seconds without a resolved op before the "
+                        "watchdog fires (default: LocalConfig)")
     p.add_argument("--reconcile", action="store_true",
                    help="double-run each seed and diff full traces")
     args = p.parse_args(argv)
+    from ..config import LocalConfig
+    from .watchdog import StallError
+    cfg = LocalConfig.from_env()
+    if args.restart_interval is not None:
+        from dataclasses import replace as _replace
+        cfg = _replace(cfg, restart_interval_s=args.restart_interval)
+    watchdog_s = None
+    if not args.no_watchdog:
+        watchdog_s = args.watchdog_stall if args.watchdog_stall is not None \
+            else cfg.stall_watchdog_after_s
     lo, _, hi = args.seeds.partition(":")
     seeds = range(int(lo), int(hi) + 1) if hi else [int(lo)]
     for seed in seeds:
@@ -582,16 +723,29 @@ def main(argv=None) -> None:
                   durability=True, journal=True,
                   delayed_stores=not args.benign, clock_drift=not args.benign,
                   cache_miss=not args.no_cache_miss,
+                  restart_nodes=not args.no_restart,
+                  stall_watchdog_s=watchdog_s,
+                  node_config=cfg,
                   max_tasks=200_000_000)
         t0 = _time.perf_counter()
-        if args.reconcile:
-            reconcile(seed, **kw)
-            print(f"seed {seed}: reconciled (rf={rf}, "
-                  f"{_time.perf_counter() - t0:.1f}s)")
-        else:
-            result = run_burn(seed, **kw)
-            print(f"seed {seed}: {result!r} (rf={rf}, "
-                  f"{_time.perf_counter() - t0:.1f}s)")
+        try:
+            if args.reconcile:
+                reconcile(seed, **kw)
+                print(f"seed {seed}: reconciled (rf={rf}, "
+                      f"{_time.perf_counter() - t0:.1f}s)")
+            else:
+                result = run_burn(seed, **kw)
+                print(f"seed {seed}: {result!r} (rf={rf}, "
+                      f"{_time.perf_counter() - t0:.1f}s)")
+        except SimulationException as e:
+            if isinstance(e.cause, StallError):
+                # actionable stall artifact for CI / seed-range sweeps: the
+                # wait-graph + status-frontier dump, then a nonzero exit —
+                # never rely on an external `timeout` kill for this signal
+                print(f"seed {seed}: STALL after "
+                      f"{_time.perf_counter() - t0:.1f}s\n{e.cause.dump}")
+                raise SystemExit(2)
+            raise
 
 
 if __name__ == "__main__":

@@ -60,6 +60,8 @@ class PendingQueue:
             entry.popped = True
             if not entry.recurring:
                 self._live_nonrecurring -= 1
+                assert self._live_nonrecurring >= 0, \
+                    "PendingQueue idle accounting went negative (double decrement)"
             self.now_micros = max(self.now_micros, entry.at)
             return entry.task
         return None
@@ -97,6 +99,8 @@ class PendingQueue:
                 self.cancelled = True
                 if not self.recurring and self._queue is not None:
                     self._queue._live_nonrecurring -= 1
+                    assert self._queue._live_nonrecurring >= 0, \
+                        "PendingQueue idle accounting went negative (double decrement)"
 
         def __lt__(self, other):
             return (self.at, self.seq) < (other.at, other.seq)
@@ -139,6 +143,71 @@ class SimScheduler(Scheduler):
         return _S()
 
 
+class NodeScheduler(Scheduler):
+    """Per-node-incarnation scheduler facade over the cluster queue.
+
+    Every task is gated on the node's incarnation still being live — a crashed
+    node's timers, progress-log polls, epoch watchdogs and read-speculation
+    beats must never fire against torn-down state.  Live one-shot entries are
+    tracked so ``Cluster.crash`` can cancel them outright (keeping the queue's
+    idle accounting exact: a wrapped no-op would otherwise pin
+    ``has_nonrecurring`` until the dead timer's deadline).  Recurring tasks
+    stop re-arming at the first post-crash fire."""
+
+    def __init__(self, cluster: "Cluster", node_id: int, incarnation: int):
+        self.cluster = cluster
+        self.node_id = node_id
+        self.incarnation = incarnation
+        self._sim = SimScheduler(cluster.queue)
+        self._entries: set = set()
+
+    def is_live(self) -> bool:
+        return (self.cluster.incarnations.get(self.node_id, 0) == self.incarnation
+                and self.node_id not in self.cluster.down)
+
+    def teardown(self) -> None:
+        """Cancel every live one-shot this node scheduled (crash path)."""
+        for entry in list(self._entries):
+            entry.cancel()
+        self._entries.clear()
+
+    def once(self, delay_s: float, run: Callable[[], None]):
+        holder = {}
+
+        def guarded():
+            entry = holder.get("e")
+            if entry is not None:
+                self._entries.discard(entry)
+            if self.is_live():
+                run()
+
+        entry = self.cluster.queue.add_after(int(delay_s * 1_000_000), guarded)
+        holder["e"] = entry
+        self._entries.add(entry)
+        entries = self._entries
+
+        class _S(Scheduler.Scheduled):
+            def cancel(self_inner):
+                entries.discard(entry)
+                entry.cancel()
+        return _S()
+
+    def recurring(self, interval_s, run: Callable[[], None]):
+        """SimScheduler's resample/fire/re-arm machinery, plus the incarnation
+        gate: a dead node's cadence no-ops and cancels itself at its first
+        post-crash fire (one orphan re-arm, then the queue forgets it)."""
+        holder = {}
+
+        def guarded():
+            if self.is_live():
+                run()
+            elif holder.get("s") is not None:
+                holder["s"].cancel()
+
+        holder["s"] = self._sim.recurring(interval_s, guarded)
+        return holder["s"]
+
+
 class LinkConfig:
     """Per-link delivery behavior (NodeSink.Action): deliver with latency, drop,
     or deliver-then-report-failure."""
@@ -168,9 +237,21 @@ class SimMessageSink(MessageSink):
     def __init__(self, node_id: int, cluster: "Cluster"):
         self.node_id = node_id
         self.cluster = cluster
-        self._next_msg_id = 0
         # msg_id -> (callback, timeout_entry, to_node)
         self.callbacks: Dict[int, Tuple[Callback, object, int]] = {}
+
+    def is_live(self) -> bool:
+        """A sink belonging to a crashed (or replaced-by-restart) incarnation
+        must neither send nor arm timeouts."""
+        return (self.cluster.sinks.get(self.node_id) is self
+                and self.node_id not in self.cluster.down)
+
+    def teardown(self) -> None:
+        """Crash path: drop every registered callback and cancel its timeout
+        entry (exact idle accounting — the timers must not pin the queue)."""
+        for _callback, timeout_entry, _to in self.callbacks.values():
+            timeout_entry.cancel()
+        self.callbacks.clear()
 
     # -- outbound -----------------------------------------------------------
     def send(self, to: int, request: Request) -> None:
@@ -180,8 +261,11 @@ class SimMessageSink(MessageSink):
         self._send(to, request, callback)
 
     def _send(self, to: int, request: Request, callback: Optional[Callback]) -> None:
-        msg_id = self._next_msg_id
-        self._next_msg_id += 1
+        if not self.is_live():
+            return   # a dead incarnation cannot put packets on the wire
+        # cluster-global msg ids: ids stay unique across a node's crash-restart
+        # boundary, so a stale reply can never correlate with a NEW callback
+        msg_id = self.cluster.alloc_msg_id()
         cluster = self.cluster
         if callback is not None:
             timeout_us = int(cluster.reply_timeout_s * 1_000_000)
@@ -193,6 +277,8 @@ class SimMessageSink(MessageSink):
         from ..messages.base import LOCAL_NO_REPLY
         if reply_context is LOCAL_NO_REPLY:
             return   # self-delivered local request: nothing to answer
+        if not self.is_live():
+            return   # dead incarnation: replies die with the process
         self.cluster.route_reply(self.node_id, to, reply_context, reply)
 
     # -- inbound correlation -------------------------------------------------
@@ -258,11 +344,21 @@ class SimConfigService(ConfigurationService):
         self.cluster = cluster
         self.node_id = node_id
         self.listeners: List[ConfigurationService.Listener] = []
+        # restart support: while set, current_topology() reports the epoch the
+        # node had durably reached at crash — the restarted Node initialises
+        # there and re-learns every later epoch through deliver_pending, so
+        # ranges adopted while it was down go through the normal bootstrap
+        # diff instead of being silently treated as first-epoch fresh space
+        self.boot_cap: Optional[int] = None
 
     def register_listener(self, listener) -> None:
         self.listeners.append(listener)
 
     def current_topology(self) -> Topology:
+        if self.boot_cap is not None:
+            capped = self.get_topology_for_epoch(self.boot_cap)
+            if capped is not None:
+                return capped
         return self.cluster.topologies[-1]
 
     def get_topology_for_epoch(self, epoch: int) -> Optional[Topology]:
@@ -278,7 +374,9 @@ class SimConfigService(ConfigurationService):
     def deliver_pending(self) -> None:
         """Deliver every not-yet-delivered epoch, in order (TopologyManager
         requires consecutive epochs)."""
-        node = self.cluster.nodes[self.node_id]
+        node = self.cluster.nodes.get(self.node_id)
+        if node is None or node.config_service is not self:
+            return   # node crashed (or this service belongs to a dead incarnation)
         while True:
             current = node.topology.current_epoch
             nxt = self.get_topology_for_epoch(current + 1) if current > 0 \
@@ -299,7 +397,12 @@ class SimConfigService(ConfigurationService):
         me = self.node_id
 
         def broadcast():
-            for other in self.cluster.nodes.values():
+            cluster = self.cluster
+            # ledger: a node restarting later re-learns completions it missed
+            # while down (gossip-on-rejoin; the live broadcast below only
+            # reaches nodes that are up right now)
+            cluster.sync_ledger.setdefault(epoch, set()).add(me)
+            for other in cluster.nodes.values():
                 other.on_remote_sync_complete(me, epoch)
         ready.reads.add_listener(lambda v, f: broadcast())
 
@@ -310,14 +413,19 @@ class DelayedAgentExecutor:
     (DelayedCommandStores.DelayedCommandStore, DelayedCommandStores.java:138-195)."""
 
     def __init__(self, agent: Agent, queue: PendingQueue, rng: RandomSource,
-                 max_delay_us: int = 1_000):
+                 max_delay_us: int = 1_000, is_live: Optional[Callable[[], bool]] = None):
         self.agent = agent
         self.queue = queue
         self.rng = rng
         self.max_delay_us = max_delay_us
+        # crash gate: a queued store task belonging to a crashed node
+        # incarnation must not run against the torn-down store
+        self.is_live = is_live
 
     def execute(self, task: Callable[[], None]) -> None:
         def run():
+            if self.is_live is not None and not self.is_live():
+                return
             try:
                 task()
             except BaseException as e:  # noqa: BLE001
@@ -384,39 +492,43 @@ class Cluster:
         self._inboxes: Dict[int, List] = {}
         self._inbox_drain_at: Dict[int, Optional[int]] = {}
         self._inbox_seq = 0
+        self._next_msg_id = 0
         self.failures: List[BaseException] = []
         self.stats: Dict[str, int] = {}
         self.nodes: Dict[int, Node] = {}
         self.sinks: Dict[int, SimMessageSink] = {}
         self.stores: Dict[int, ListStore] = {}
         self.journal = None
-        plf = None
+        # crash-restart lifecycle: currently-down node ids, per-node
+        # incarnation counters (bumped at crash, so every queued delivery /
+        # timer belonging to the dead incarnation is invalidated), durable
+        # restart metadata captured at crash, and the epoch-sync ledger a
+        # restarted node replays on rejoin
+        self.down: set = set()
+        self.incarnations: Dict[int, int] = {}
+        self._crash_info: Dict[int, dict] = {}
+        # catch-up ranges a restart has accepted but not yet handed to
+        # Bootstrap (the +1us relaunch task): a second crash inside that
+        # window must re-inherit them, not forget the data holes
+        self._pending_catchup: Dict[int, object] = {}
+        self.sync_ledger: Dict[int, set] = {}
+        # fired with the freshly-rebuilt Node after every restart (the burn
+        # re-applies per-node wiring: durability scheduling, store flags)
+        self.on_restart_hooks: List[Callable] = []
+        self._plf = None
         if progress_log:
             from ..impl.progress_log import progress_log_factory
-            plf = progress_log_factory(progress_poll_s)
-        agent = SimAgent(self)
+            self._plf = progress_log_factory(progress_poll_s)
+        self.agent = SimAgent(self)
+        self._num_shards = num_shards
+        self._delayed_stores = delayed_stores
+        self._resolver = resolver
+        self._node_config = node_config
         # per-node clock drift (FrequentLargeRange nowSupplier, BurnTest:329-339)
         self.clock_offsets: Dict[int, int] = {}
         for node_id in sorted(set(topology.nodes()) | set(extra_nodes or ())):
-            sink = SimMessageSink(node_id, self)
-            store = ListStore(node_id)
-            self.sinks[node_id] = sink
-            self.stores[node_id] = store
-            executor_factory = None
-            if delayed_stores:
-                exec_rng = self.rng.fork()
-                executor_factory = (lambda rng: (lambda i: DelayedAgentExecutor(
-                    agent, self.queue, rng.fork())))(exec_rng)
-            self.nodes[node_id] = Node(
-                node_id, sink, SimConfigService(self, node_id), agent,
-                self.scheduler, store, self.rng.fork(),
-                now_micros=(lambda nid: (lambda: self.queue.now_micros
-                                         + self.clock_offsets.get(nid, 0)))(node_id),
-                num_shards=num_shards,
-                executor_factory=executor_factory,
-                progress_log_factory=plf,
-                resolver=resolver,
-                config=node_config)
+            self.stores[node_id] = ListStore(node_id)
+            self.nodes[node_id] = self._make_node(node_id)
             if clock_drift:
                 self._start_drift(node_id)
         if journal:
@@ -428,6 +540,166 @@ class Cluster:
         # chaos link configs re-randomize themselves off the cluster queue
         if hasattr(self.link, "attach"):
             self.link.attach(self)
+
+    def alloc_msg_id(self) -> int:
+        self._next_msg_id += 1
+        return self._next_msg_id
+
+    def _make_node(self, node_id: int, boot_epoch: Optional[int] = None) -> Node:
+        """Construct one Node (initial boot or restart).  ``boot_epoch`` caps
+        the topology the node initialises with (the epoch it had durably
+        reached at crash); later epochs stream in via deliver_pending."""
+        incarnation = self.incarnations.get(node_id, 0)
+        sink = SimMessageSink(node_id, self)
+        self.sinks[node_id] = sink
+        store = self.stores[node_id]
+        svc = SimConfigService(self, node_id)
+        scheduler = NodeScheduler(self, node_id, incarnation)
+        executor_factory = None
+        if self._delayed_stores:
+            exec_rng = self.rng.fork()
+            is_live = scheduler.is_live
+            executor_factory = (lambda rng: (lambda i: DelayedAgentExecutor(
+                self.agent, self.queue, rng.fork(), is_live=is_live)))(exec_rng)
+        svc.boot_cap = boot_epoch
+        try:
+            node = Node(
+                node_id, sink, svc, self.agent,
+                scheduler, store, self.rng.fork(),
+                now_micros=(lambda nid: (lambda: self.queue.now_micros
+                                         + self.clock_offsets.get(nid, 0)))(node_id),
+                num_shards=self._num_shards,
+                executor_factory=executor_factory,
+                progress_log_factory=self._plf,
+                resolver=self._resolver,
+                config=self._node_config)
+        finally:
+            svc.boot_cap = None
+        return node
+
+    # -- crash-restart lifecycle (the crash-restart nemesis substrate) --------
+    def crash(self, node_id: int) -> None:
+        """Kill a node mid-flight: its in-memory command stores, per-key
+        indexes, device mirrors, message callbacks and timers are destroyed
+        and messages in flight to it are dropped.  The durable stores — the
+        journal and the data files (ListStore) — survive for ``restart``."""
+        assert node_id in self.nodes and node_id not in self.down, \
+            f"node {node_id} is not live"
+        assert self.journal is not None, \
+            "crash-restart requires the journal (the restart store of record)"
+        assert self._num_shards == 1, \
+            "restart replay keys journal logs by store id; multi-store range " \
+            "assignment is not stable across a restart boundary"
+        node = self.nodes.pop(node_id)
+        self.down.add(node_id)
+        # invalidate every queued delivery/timer addressed to this incarnation
+        self.incarnations[node_id] = self.incarnations.get(node_id, 0) + 1
+        # durable restart metadata (real nodes persist bootstrap progress
+        # markers: losing them would let a half-bootstrapped replica serve
+        # reads over ranges it never fetched).  Data-store stale marks are
+        # held by VOLATILE heal machinery that dies with the process, so the
+        # marked ranges re-enter the catch-up ladder at restart instead.
+        data = self.stores[node_id]
+        pending = data.stale_ranges
+        for cs in node.command_stores.all_stores():
+            pending = pending.union(cs.pending_bootstrap)
+        # debt the previous restart never got to hand to Bootstrap (crashed
+        # again before its relaunch task fired): still owed after this crash
+        leftover = self._pending_catchup.pop(node_id, None)
+        if leftover is not None:
+            pending = pending.union(leftover)
+        self._crash_info[node_id] = {
+            "epoch": node.topology.current_epoch,
+            "pending": pending,
+        }
+        data._stale_marks.clear()
+        # tear down volatile machinery without corrupting idle accounting:
+        # progress-log polls, node timers, reply callbacks + their timeouts
+        for cs in node.command_stores.all_stores():
+            close = getattr(cs.progress_log, "close", None)
+            if close is not None:
+                close()
+        if isinstance(node.scheduler, NodeScheduler):
+            node.scheduler.teardown()
+        self.sinks[node_id].teardown()
+        # purge the request-coalescing inbox (those messages were in RAM)
+        self._inboxes.pop(node_id, None)
+        self._inbox_drain_at.pop(node_id, None)
+        self._count("node_crashes")
+
+    def restart(self, node_id: int, lose_tail: int = 0) -> Node:
+        """Bring a crashed node back: reconstruct every command store from its
+        journal (volatile execution state is lost — commands resume from
+        their durable tier, STABLE / PRE_APPLIED), re-register with the
+        topology service, replay the epoch-sync ledger, and re-enter the
+        bootstrap catch-up ladder for ranges whose fetch the crash killed.
+        ``lose_tail`` optionally drops the last N journal records per store
+        first (unsynced-tail loss experiments; NOT sound for promises)."""
+        assert node_id in self.down, f"node {node_id} is not down"
+        info = self._crash_info.pop(node_id)
+        if lose_tail:
+            for sid in range(self._num_shards):
+                self.journal.drop_tail(node_id, sid, lose_tail)
+        self.down.discard(node_id)
+        node = self._make_node(node_id, boot_epoch=info["epoch"])
+        self.nodes[node_id] = node
+        # topology metadata is durable on a real node: re-install every epoch
+        # below the boot epoch BEFORE journal replay — replay's waiting_on
+        # re-derivation judges each dep's participation against the ranges the
+        # store owned AT THE DEP'S EPOCH (ranges_at), and an unknown old epoch
+        # reads as "never owned", silently dropping the dep from the execution
+        # frontier (seed-0 replica divergence: a later write applied over an
+        # unapplied earlier one).  Also keeps precise_epochs answerable for
+        # old transactions (client probes, recovery).
+        for topo in sorted(self.topologies, key=lambda t: t.epoch, reverse=True):
+            if topo.epoch < node.topology.min_epoch:
+                node.topology.reload_prior_epoch(
+                    topo, self.sync_ledger.get(topo.epoch))
+                node.command_stores.update_topology(topo)
+        from ..local import commands as C
+        from ..local.command_store import CommandStore, SafeCommandStore
+        for cs in node.command_stores.all_stores():
+            self.journal.attach(cs)
+            rebuilt = self.journal.restart_commands(node_id, cs.id)
+            # synchronous replay (process start blocks on journal replay),
+            # under the store's logical-thread discipline
+            prev, CommandStore._current = CommandStore._current, cs
+            try:
+                C.replay_journal(SafeCommandStore(cs), rebuilt)
+            finally:
+                CommandStore._current = prev
+            resume = getattr(cs.progress_log, "resume_after_restart", None)
+            if resume is not None:
+                resume()
+        # stream the epochs the node missed while down (adoption diffs fire
+        # normal bootstraps), then replay sync completions peers broadcast
+        self.queue.add_after(0, node.config_service.deliver_pending)
+        for epoch in sorted(self.sync_ledger):
+            for n in sorted(self.sync_ledger[epoch]):
+                if n != node_id:
+                    node.on_remote_sync_complete(n, epoch)
+        pending = info["pending"]
+        if pending:
+            self._pending_catchup[node_id] = pending
+
+            def relaunch():
+                from ..local.bootstrap import Bootstrap
+                cur = self.nodes.get(node_id)
+                if cur is not node:
+                    return   # crashed again: crash() re-inherited the debt
+                self._pending_catchup.pop(node_id, None)
+                for cs in node.command_stores.all_stores():
+                    mine = pending.intersection(cs.all_ranges()) \
+                        .without(cs.pending_bootstrap)
+                    if mine:
+                        Bootstrap(node, cs, mine, node.epoch(),
+                                  catch_up=True).start()
+            # after deliver_pending so ownership reflects the live topology
+            self.queue.add_after(1, relaunch)
+        for hook in list(self.on_restart_hooks):
+            hook(node)
+        self._count("node_restarts")
+        return node
 
     def _start_drift(self, node_id: int) -> None:
         """Random-walk clock drift: small 50µs-5ms jumps, occasional 1-10ms
@@ -466,6 +738,18 @@ class Cluster:
                 self.request_filter(from_node, to_node, request, msg_id,
                                     has_callback):
             return
+        if to_node in self.down:
+            # connection refused: the sender observes it as a link failure
+            if self.tracer is not None:
+                self.tracer("DOWN", from_node, to_node, msg_id, request,
+                            self.queue.now_micros)
+            if has_callback:
+                self.queue.add_after(
+                    self.link.latency_us(from_node, to_node),
+                    lambda: self.sinks[from_node].report_failure(
+                        msg_id, to_node,
+                        ConnectionError(f"node {to_node} is down")))
+            return
         action = self.link.action(from_node, to_node, request) if from_node != to_node \
             else LinkConfig.DELIVER
         if self.tracer is not None:
@@ -483,8 +767,9 @@ class Cluster:
         if self.batch_window_us > 0:
             self._inbox_deliver(to_node, request, from_node, ctx, latency)
         else:
+            inc = self.incarnations.get(to_node, 0)
             self.queue.add_after(latency, lambda: self._deliver(
-                to_node, request, from_node, ctx))
+                to_node, request, from_node, ctx, inc))
         if action == LinkConfig.DELIVER_WITH_FAILURE and has_callback:
             self.queue.add_after(
                 self.link.latency_us(from_node, to_node),
@@ -492,11 +777,18 @@ class Cluster:
                     msg_id, to_node, ConnectionError(f"link {from_node}->{to_node}")))
 
     def _deliver(self, to_node: int, request: Request, from_node: int,
-                 ctx: "ReplyContext") -> None:
+                 ctx: "ReplyContext", incarnation: Optional[int] = None) -> None:
+        if to_node in self.down or (
+                incarnation is not None
+                and incarnation != self.incarnations.get(to_node, 0)):
+            return   # the TCP connection died with the target's process
+        node = self.nodes.get(to_node)
+        if node is None:
+            return
         if self.tracer is not None:
             self.tracer("RECV", from_node, to_node, ctx.msg_id, request,
                         self.queue.now_micros)
-        self.nodes[to_node].receive(request, from_node, ctx)
+        node.receive(request, from_node, ctx)
 
     def route_reply(self, from_node: int, to_node: int, reply_context: ReplyContext,
                     reply: Reply) -> None:
@@ -508,9 +800,14 @@ class Cluster:
                         reply_context.msg_id, reply, self.queue.now_micros)
         if action in (LinkConfig.DROP, LinkConfig.FAILURE):
             return
+        if to_node in self.down:
+            return   # replies to a down node vanish with its connections
         latency = 0 if from_node == to_node else self.link.latency_us(from_node, to_node)
+        inc = self.incarnations.get(to_node, 0)
 
         def deliver():
+            if to_node in self.down or inc != self.incarnations.get(to_node, 0):
+                return  # the recipient crashed while the reply was in flight
             if self.tracer is not None:
                 self.tracer("RECV_RPLY", from_node, to_node,
                             reply_context.msg_id, reply, self.queue.now_micros)

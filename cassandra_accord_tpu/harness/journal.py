@@ -45,6 +45,13 @@ class Journal:
         # discover it is unchanged (dominant cost in hostile burns);
         # verify_against still proves the recorded state sufficient
         self._raw: Dict[Tuple[int, int, TxnId], Dict[str, object]] = {}
+        # global append order per (node, store): the write-ahead sequence a
+        # drop_tail (unsynced-tail loss) truncation operates on
+        self._order: Dict[Tuple[int, int], List[TxnId]] = {}
+        # erased-entry count per (node, store): erase() leaves stale TxnIds in
+        # _order; once they outnumber the live ones the list is compacted, so
+        # a long GC-heavy burn doesn't pin one dead reference per save forever
+        self._order_dead: Dict[Tuple[int, int], int] = {}
         self.records = 0
 
     def attach(self, store) -> None:
@@ -77,15 +84,24 @@ class Journal:
             self._routes.pop(key3, None)
         self.logs.setdefault(key3[:2], {}).setdefault(command.txn_id, []) \
             .append(diff)
+        self._order.setdefault(key3[:2], []).append(command.txn_id)
         self.records += 1
 
     def erase(self, store, txn_id: TxnId) -> None:
         """GC erasure also erases the journal entry (tombstone drop)."""
         key = (store.node.id, store.id)
-        self.logs.get(key, {}).pop(txn_id, None)
+        logs = self.logs.get(key, {})
+        diffs = logs.pop(txn_id, None)
         self._last.pop(key + (txn_id,), None)
         self._routes.pop(key + (txn_id,), None)
         self._raw.pop(key + (txn_id,), None)
+        if diffs:
+            dead = self._order_dead.get(key, 0) + len(diffs)
+            order = self._order.get(key)
+            if order is not None and dead * 2 > len(order):
+                order[:] = [t for t in order if t in logs]
+                dead = 0
+            self._order_dead[key] = dead
 
     def on_evict(self, store, txn_id: TxnId) -> None:
         """The store evicted this command: drop the raw-identity memo so the
@@ -133,6 +149,50 @@ class Journal:
         for field, encoded in full.items():
             setattr(command, field, codec.decode_value(encoded))
         return command
+
+    # -- restart (crash-restart nemesis) --------------------------------------
+    def restart_commands(self, node_id: int, store_id: int) -> Dict[TxnId, Command]:
+        """Reconstruct a crashed store's commands for restart: everything the
+        journal recorded, with legitimately-volatile state collapsed to its
+        durable tier (READY_TO_EXECUTE resumes from STABLE, APPLYING from
+        PRE_APPLIED — the round-3 replay contract).  waiting_on / listeners
+        are never journaled: the restart path re-derives them."""
+        rebuilt = self.reconstruct(node_id, store_id)
+        for command in rebuilt.values():
+            command.save_status = self._durable_status(command.save_status)
+        return rebuilt
+
+    def drop_tail(self, node_id: int, store_id: int, count: int) -> int:
+        """Drop the last ``count`` records of a store's log — simulated loss
+        of an unsynced write-ahead tail at crash.  Returns records dropped.
+        NOTE: losing promise/accept records is NOT sound for consensus (a
+        real journal fsyncs before replying); this exists for targeted
+        durability experiments, not the default hostile matrix."""
+        key = (node_id, store_id)
+        order = self._order.get(key, [])
+        logs = self.logs.get(key, {})
+        dropped = 0
+        while dropped < count and order:
+            txn_id = order.pop()
+            diffs = logs.get(txn_id)
+            if not diffs:
+                continue   # erased since; its order entries are stale
+            diffs.pop()
+            dropped += 1
+            key3 = key + (txn_id,)
+            self._raw.pop(key3, None)
+            self._routes.pop(key3, None)
+            if not diffs:
+                del logs[txn_id]
+                self._last.pop(key3, None)
+            else:
+                # rebuild the latest-state snapshot from the surviving diffs
+                full: Dict[str, object] = {}
+                for diff in diffs:
+                    full.update(diff)
+                self._last[key3] = full
+        self.records -= dropped
+        return dropped
 
     # -- verification ---------------------------------------------------------
     @staticmethod

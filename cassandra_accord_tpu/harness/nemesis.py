@@ -1,0 +1,114 @@
+"""The crash-restart nemesis: kill nodes mid-burn, rebuild them from journal
+replay.
+
+Capability parity with the reference burn's node-restart axis (BurnTest's
+journal-backed restarts: a node's in-memory state is discarded and
+reconstructed from its journal, then the protocol heals what the journal
+predates).  At seeded, jittered points in a burn a victim is crashed via
+``Cluster.crash`` — volatile stores, caches, device mirrors, callbacks and
+timers destroyed, in-flight messages to it dropped — and restarted after a
+seeded downtime via ``Cluster.restart`` (journal replay + topology re-join +
+bootstrap catch-up).
+
+Safety rails (LocalConfig knobs): at most ``restart_max_down`` nodes down at
+once, and a victim is only eligible if every shard it replicates keeps a live
+slow-path quorum (``restart_keep_quorum``) — without that floor, stalls are
+expected rather than bugs.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..utils.random import RandomSource
+from .cluster import Cluster
+
+
+class RestartNemesis:
+    """One per burn; schedule driven by the cluster's deterministic queue."""
+
+    def __init__(self, cluster: Cluster, rng: RandomSource,
+                 interval_s: float = 20.0,
+                 downtime_min_s: float = 2.0, downtime_max_s: float = 12.0,
+                 max_down: int = 1, keep_quorum: bool = True,
+                 on_crash: Optional[Callable[[int], None]] = None,
+                 on_restart: Optional[Callable[[object], None]] = None):
+        self.cluster = cluster
+        self.rng = rng
+        self.interval_s = interval_s
+        self.downtime_min_s = downtime_min_s
+        self.downtime_max_s = max(downtime_max_s, downtime_min_s)
+        self.max_down = max_down
+        self.keep_quorum = keep_quorum
+        self.on_crash = on_crash
+        self.on_restart = on_restart
+        self.stopped = False
+        self._task = None
+
+    def attach(self) -> None:
+        """Register the jittered crash cadence (never aligned with the chaos
+        re-roll interval: each gap is resampled in [0.5, 1.5) x interval)."""
+        rng = self.rng
+
+        def gap():
+            return self.interval_s * (0.5 + rng.next_float())
+
+        self._task = self.cluster.scheduler.recurring(gap, self._tick)
+
+    # -- the schedule --------------------------------------------------------
+    def _tick(self) -> None:
+        if self.stopped or len(self.cluster.down) >= self.max_down:
+            return
+        victim = self._pick_victim()
+        if victim is None:
+            return
+        self.cluster.crash(victim)
+        if self.on_crash is not None:
+            self.on_crash(victim)
+        downtime = self.downtime_min_s + self.rng.next_float() * (
+            self.downtime_max_s - self.downtime_min_s)
+        self.cluster.scheduler.once(downtime, lambda: self._restart(victim))
+
+    def _pick_victim(self) -> Optional[int]:
+        candidates = []
+        for node_id in sorted(self.cluster.nodes):
+            if node_id in self.cluster.down:
+                continue
+            if self.keep_quorum and not self._quorum_safe(node_id):
+                continue
+            candidates.append(node_id)
+        return self.rng.pick(candidates) if candidates else None
+
+    def _quorum_safe(self, node_id: int) -> bool:
+        """Would crashing ``node_id`` leave every shard it replicates — in
+        EVERY installed epoch, not only the latest — with a live slow-path
+        quorum?  Old epochs matter: a txn coordinated or recovered against a
+        pre-churn shard still needs that shard's quorum until the epoch
+        retires, so checking only ``topologies[-1]`` would let
+        ``restart_max_down >= 2`` crash two members of an old shard and
+        produce an *expected* stall the watchdog then reports as a bug.
+        (Conservative: epochs whose txns have all settled are still counted.)"""
+        would_down = self.cluster.down | {node_id}
+        for topology in self.cluster.topologies:
+            for shard in topology.shards:
+                if node_id in shard.nodes:
+                    live = sum(1 for n in shard.nodes if n not in would_down)
+                    if live < shard.slow_path_quorum_size:
+                        return False
+        return True
+
+    def _restart(self, node_id: int) -> None:
+        if node_id not in self.cluster.down:
+            return   # already restored (stop_and_restore raced the timer)
+        node = self.cluster.restart(node_id)
+        if self.on_restart is not None:
+            self.on_restart(node)
+
+    # -- quiesce -------------------------------------------------------------
+    def stop_and_restore(self) -> None:
+        """Stop crashing and bring every down node back (burn quiesce: the
+        final agreement checks need the full replica set live and caught up)."""
+        self.stopped = True
+        if self._task is not None:
+            self._task.cancel()
+        for node_id in sorted(self.cluster.down):
+            self._restart(node_id)

@@ -112,6 +112,28 @@ class SimpleProgressLog(ProgressLog):
     def close(self) -> None:
         self._scheduled.cancel()
 
+    def resume_after_restart(self) -> None:
+        """Post-restart re-investigation seeding (crash-restart nemesis): the
+        crash destroyed every monitor, so scan the journal-rebuilt store and
+        re-register them.  Undecided home-shard txns resume CoordinateState
+        monitoring — a crashed COORDINATOR's own in-flight txns land here, and
+        if nothing advances them the next polls escalate through maybe_recover
+        so peers supersede the dead coordination (commit or invalidate).
+        Non-home undecided txns resume the InformHomeOfTxn path.  Blocked
+        STABLE/PRE_APPLIED txns re-enter blocking monitoring separately, via
+        the replay pass's maybe_execute -> waiting() callbacks."""
+        store = self.store
+        for txn_id, command in list(store.commands.items()):
+            if command.save_status.ordinal >= SaveStatus.APPLIED.ordinal \
+                    or command.save_status is SaveStatus.INVALIDATED \
+                    or command.save_status.is_truncated:
+                continue
+            if command.durability.is_durable or command.route is None:
+                continue
+            progress_shard = store.current_ranges().contains(
+                command.route.home_key)
+            self._track(command, progress_shard)
+
     # -- lifecycle callbacks (home shard monitoring) -------------------------
     def _track(self, command, progress_shard: bool) -> None:
         if command.route is None:

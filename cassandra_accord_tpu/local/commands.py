@@ -265,12 +265,7 @@ def adopt_truncated_outcome(safe_store: SafeCommandStore, command: Command,
         # case).  Owned keys only — unowned registry entries would never GC
         # (shard_redundant_before has no bound for them)
         if writes is not None and not writes.is_empty():
-            tfk = safe_store.store.timestamps_for_key
-            owned = safe_store.store.all_ranges()
-            for key in writes.keys:
-                rk = key.to_routing() if hasattr(key, "to_routing") else key
-                if owned.contains(rk):
-                    tfk.merge_applied_write(key, execute_at)
+            _merge_applied_writes(safe_store.store, writes, execute_at)
         command.partial_txn = None
         command.partial_deps = None
         command.waiting_on = None
@@ -303,6 +298,19 @@ def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId,
     safe_store.register_witness(command, InternalStatus.INVALIDATED)
     safe_store.progress_log().invalidated(command, _is_progress_shard(safe_store, command))
     safe_store.notify_listeners(command)
+
+
+def _merge_applied_writes(store, writes, execute_at) -> None:
+    """Merge a write's per-key execution registers monotonically, without
+    write-order validation (out-of-dependency-order landings: truncation
+    adoption, restart replay).  Owned keys only — unowned registry entries
+    would never GC (shard_redundant_before has no bound for them)."""
+    tfk = store.timestamps_for_key
+    owned = store.all_ranges()
+    for key in writes.keys:
+        rk = key.to_routing() if hasattr(key, "to_routing") else key
+        if owned.contains(rk):
+            tfk.merge_applied_write(key, execute_at)
 
 
 # ---------------------------------------------------------------------------
@@ -458,7 +466,15 @@ def _still_blocks(safe_store: SafeCommandStore, command: Command, dep_id: TxnId,
         return False
     if dep.has_been(Status.PRE_COMMITTED) and not command.txn_id.awaits_only_deps:
         dep_ea = dep.effective_execute_at()
-        if dep_ea is not None and dep_ea > execute_at:
+        # >= not >: genuine executeAts of distinct txns are never equal
+        # (unique_now hlc+node tiebreak), so equality can only mean the dep
+        # is a sync point DEFERRED to exactly our executeAt
+        # (updateExecuteAtLeast adopting dep.execute_at) — it waits on OUR
+        # apply and executes after us.  Treating the tie as blocking built a
+        # permanent write<->fence wait cycle (the PRE_APPLIED-backlog stall
+        # root, found by the restart-matrix stall watchdog: W [STABLE]
+        # waiting_on=[X], X [PRE_APPLIED] waiting_on=[W]).
+        if dep_ea is not None and dep_ea >= execute_at:
             return False  # dep executes (or was deferred to execute) after us
     return True
 
@@ -602,6 +618,63 @@ def _apply_writes(safe_store: SafeCommandStore, command: Command) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Journal replay (node restart; the reference's Journal.replay -> Commands load)
+# ---------------------------------------------------------------------------
+
+# save_status -> witness-plane registration at replay, mirroring what the live
+# transition path registered (ACCEPTED_INVALIDATE / PRE_COMMITTED / ERASED are
+# not indexed on the live path either; PRE_APPLIED registers COMMITTED exactly
+# as apply_ does pre-execution)
+_REPLAY_WITNESS = {
+    SaveStatus.PRE_ACCEPTED: InternalStatus.PREACCEPTED,
+    SaveStatus.ACCEPTED: InternalStatus.ACCEPTED,
+    SaveStatus.COMMITTED: InternalStatus.COMMITTED,
+    SaveStatus.STABLE: InternalStatus.STABLE,
+    SaveStatus.PRE_APPLIED: InternalStatus.COMMITTED,
+    SaveStatus.APPLIED: InternalStatus.APPLIED,
+    SaveStatus.TRUNCATED_APPLY: InternalStatus.APPLIED,
+    SaveStatus.INVALIDATED: InternalStatus.INVALIDATED,
+}
+
+
+def replay_journal(safe_store: SafeCommandStore, rebuilt) -> None:
+    """Install journal-reconstructed commands into a FRESH store (restart after
+    crash).  Volatile state was lost with the process: commands arrive at
+    their durable tier (STABLE / PRE_APPLIED at most transient-wise) with no
+    waiting_on and no listeners.  Two passes keep the planes consistent:
+
+    1. install + re-index every command (cfk / resolver / range table /
+       max-conflicts via register_witness; per-key execution registers for
+       terminal applied writes, merged monotonically — replay order is
+       arbitrary, so no write-order validation);
+    2. re-derive the execution frontier (initialise_waiting_on) for
+       STABLE / PRE_APPLIED commands and resume execution.  Deps that are
+       unknown locally (their Commit/Apply was in flight to the dead node)
+       stay in waiting_on; maybe_execute reports them to the progress log's
+       blocked-dependency machinery, which fetches or recovers them — that is
+       how a restarted replica catches up past what its journal predates."""
+    store = safe_store.store
+    for txn_id, command in rebuilt.items():
+        # NOT_DEFINED records (e.g. an InformOfTxn-created stub) install too —
+        # the journal tracks them, so the store must keep tracking them or the
+        # end-of-burn persistence check reads the gap as an untracked erasure
+        store.commands[txn_id] = command
+        status = _REPLAY_WITNESS.get(command.save_status)
+        if status is not None:
+            safe_store.register_witness(command, status)
+        if command.save_status in (SaveStatus.APPLIED, SaveStatus.TRUNCATED_APPLY) \
+                and command.writes is not None and not command.writes.is_empty() \
+                and command.execute_at is not None:
+            # empty-writes gate mirrors the live apply paths: a range READ's
+            # Writes carries its read footprint (Ranges) in .keys
+            _merge_applied_writes(store, command.writes, command.execute_at)
+    for command in list(rebuilt.values()):
+        if command.save_status in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
+            initialise_waiting_on(safe_store, command)
+            maybe_execute(safe_store, command, always_notify_listeners=False)
+
+
+# ---------------------------------------------------------------------------
 # Truncation / erasure (Commands.java:824-930, Cleanup.java)
 # ---------------------------------------------------------------------------
 
@@ -632,12 +705,8 @@ def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
                 # here, or an adopted outcome): land its OWN writes locally
                 # before anything else — no network needed for this txn's gap
                 command.writes.apply_to(safe_store, safe_store.store.all_ranges())
-                owned = safe_store.store.all_ranges()
-                for key in command.writes.keys:
-                    rk = key.to_routing() if hasattr(key, "to_routing") else key
-                    if owned.contains(rk):
-                        safe_store.store.timestamps_for_key.merge_applied_write(
-                            key, command.execute_at)
+                _merge_applied_writes(safe_store.store, command.writes,
+                                      command.execute_at)
             # predecessors may be missing too (that is WHY this txn never
             # applied): stale-mark + peer-snapshot heal over the footprint
             from ..messages.status_messages import _heal_store_gaps

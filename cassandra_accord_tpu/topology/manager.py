@@ -127,6 +127,28 @@ class TopologyManager:
         state.synced_nodes.add(node)
         state.recompute_sync()
 
+    def reload_prior_epoch(self, topology: Topology,
+                           synced_nodes: Optional[Set[int]] = None) -> None:
+        """Restart path (crash-restart nemesis): re-install a durably-known
+        epoch OLDER than the boot epoch.  Topology metadata is durable state
+        on a real node — a restarted node must still answer
+        ``precise_epochs`` for transactions that started in epochs its
+        in-memory manager was rebuilt after.  Prepends strictly-consecutive
+        epochs below ``min_epoch``; closure/redundancy marks are volatile and
+        conservatively reset (they re-accumulate from durability rounds)."""
+        check_state(bool(self._epochs), "boot epoch must be installed first")
+        check_argument(topology.epoch == self._min_epoch - 1,
+                       "prior-epoch reload must be consecutive: expected %s, got %s",
+                       self._min_epoch - 1, topology.epoch)
+        state = _EpochState(topology)
+        state.synced_nodes = set(synced_nodes or ())
+        # the first epoch overall has no predecessor to sync from
+        state.sync_complete = topology.epoch == 1
+        state.recompute_sync()
+        state.ready = EpochReady.done(topology.epoch)
+        self._epochs.insert(0, state)
+        self._min_epoch = topology.epoch
+
     def truncate_until(self, epoch: int) -> None:
         """Drop epochs strictly below ``epoch`` (topology GC)."""
         if epoch <= self._min_epoch:

@@ -1,0 +1,484 @@
+"""Crash-restart nemesis: node death mid-protocol, journal-replay rebuild,
+peer recovery of a dead coordinator's in-flight txns, and the stall watchdog.
+
+Parity targets: the reference burn's node-restart axis (BurnTest's
+journal-backed restarts) — a node's in-memory state is discarded and
+reconstructed from its journal (volatile execution state collapses to its
+durable tier), then bootstrap/staleness catch-up and peer recovery heal what
+the journal predates.  Covers the satellite checklist of ISSUE 1:
+journal round-trip per status, PendingQueue idle-accounting hardening,
+deterministic coordinator-crash recovery, the watchdog's wait-graph dump,
+the restart smoke burn (tier-1) and the gated restart x hostile matrix.
+"""
+import os
+from dataclasses import replace
+from types import SimpleNamespace
+
+import pytest
+
+from cassandra_accord_tpu.config import LocalConfig
+from cassandra_accord_tpu.harness.burn import SimulationException, run_burn
+from cassandra_accord_tpu.harness.cluster import Cluster, LinkConfig, PendingQueue
+from cassandra_accord_tpu.harness.journal import _FIELDS, Journal
+from cassandra_accord_tpu.harness.watchdog import StallError, StallWatchdog, dump_wait_state
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.local.command import Command, WaitingOn
+from cassandra_accord_tpu.local.status import SaveStatus
+from cassandra_accord_tpu.maelstrom import codec
+from cassandra_accord_tpu.primitives.keys import IntKey, Range
+from cassandra_accord_tpu.primitives.timestamp import TxnId
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v):
+    return IntKey(v)
+
+
+def make_cluster(seed=1, nodes=(1, 2, 3), link=None, progress_poll_s=0.2):
+    shards = [Shard(Range(k(0), k(1000)), list(nodes))]
+    cluster = Cluster(Topology(1, shards), seed=seed, link_config=link,
+                      journal=True, progress_log=True,
+                      progress_poll_s=progress_poll_s)
+    return cluster
+
+
+def find_command(cluster, node_id, txn_id):
+    for store in cluster.nodes[node_id].command_stores.all_stores():
+        cmd = store.commands.get(txn_id)
+        if cmd is not None:
+            return cmd
+    return None
+
+
+def restart_config(**overrides):
+    return replace(LocalConfig(), **overrides)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: journal round-trip per command status
+# ---------------------------------------------------------------------------
+
+# restart resumes from the durable tier: transient LocalExecution sub-states
+# collapse (round-3 replay contract); everything else survives unchanged
+_EXPECTED_COLLAPSE = {
+    SaveStatus.READY_TO_EXECUTE: SaveStatus.STABLE,
+    SaveStatus.APPLYING: SaveStatus.PRE_APPLIED,
+}
+
+
+def _applied_template():
+    """A real APPLIED command (route, definition, deps, writes, result all
+    populated by the live protocol) to clone per-status."""
+    cluster = make_cluster(seed=9)
+    res = cluster.nodes[1].coordinate(list_txn([k(5)], {k(5): "tpl"}))
+    assert cluster.run_until(res.is_done)
+    cluster.run_until_idle()
+    for store in cluster.nodes[1].command_stores.all_stores():
+        for cmd in store.commands.values():
+            if cmd.save_status is SaveStatus.APPLIED:
+                return cmd
+    raise AssertionError("no applied command produced")
+
+
+def _clone_with_status(template, status):
+    copy = Command(template.txn_id)
+    for f in _FIELDS:
+        setattr(copy, f, codec.decode_value(codec.encode_value(getattr(template, f))))
+    copy.save_status = status
+    # volatile execution state the crash must destroy
+    copy.waiting_on = WaitingOn({TxnId(1, 1, 1)})
+    copy.listeners = {TxnId(1, 2, 1)}
+    return copy
+
+
+@pytest.mark.parametrize("status", list(SaveStatus), ids=lambda s: s.name)
+def test_journal_restart_roundtrip_per_status(status):
+    """`restart_commands` after a simulated crash, for every SaveStatus:
+    volatile fields (waiting_on, listeners, transient sub-states) are
+    dropped; durable fields survive byte-for-byte."""
+    template = _applied_template()
+    command = _clone_with_status(template, status)
+    journal = Journal()
+    store = SimpleNamespace(node=SimpleNamespace(id=7), id=0)
+    journal.save(store, command)
+
+    rebuilt = journal.restart_commands(7, 0)
+    assert set(rebuilt) == {command.txn_id}
+    copy = rebuilt[command.txn_id]
+    assert copy.save_status is _EXPECTED_COLLAPSE.get(status, status)
+    # never journaled: the restart path re-derives the execution frontier
+    assert copy.waiting_on is None
+    assert copy.listeners == set()
+    for f in _FIELDS:
+        if f == "save_status":
+            continue
+        assert codec.encode_value(getattr(copy, f)) \
+            == codec.encode_value(getattr(command, f)), \
+            f"{status.name}: durable field {f} did not survive byte-for-byte"
+
+
+def test_journal_restart_roundtrip_after_burn():
+    """After a whole benign burn, every store's journal rebuilds the full
+    command set at the durable tier (the live burn's verify_against, but
+    through the restart entry point)."""
+    result = run_burn(11, ops=30, journal=True)
+    assert result.ops_ok == 30
+    from cassandra_accord_tpu.harness.burn import last_cluster
+    cluster = last_cluster()
+    checked = 0
+    for node in cluster.nodes.values():
+        for store in node.command_stores.all_stores():
+            rebuilt = cluster.journal.restart_commands(node.id, store.id)
+            for txn_id, cmd in store.commands.items():
+                if cmd.save_status is SaveStatus.NOT_DEFINED:
+                    continue
+                copy = rebuilt[txn_id]
+                assert copy.save_status is Journal._durable_status(cmd.save_status)
+                assert copy.waiting_on is None
+                checked += 1
+    assert checked > 0
+
+
+def test_journal_drop_tail_rewinds_latest_state():
+    """Unsynced-tail loss: drop_tail removes the newest records and rewinds
+    the latest-state snapshot to the surviving prefix."""
+    template = _applied_template()
+    journal = Journal()
+    store = SimpleNamespace(node=SimpleNamespace(id=3), id=0)
+    pre = _clone_with_status(template, SaveStatus.STABLE)
+    journal.save(store, pre)
+    post = _clone_with_status(template, SaveStatus.APPLIED)
+    journal.save(store, post)
+    assert journal.restart_commands(3, 0)[template.txn_id].save_status \
+        is SaveStatus.APPLIED
+
+    dropped = journal.drop_tail(3, 0, 1)
+    assert dropped == 1
+    assert journal.restart_commands(3, 0)[template.txn_id].save_status \
+        is SaveStatus.STABLE
+    # dropping the remaining record erases the txn entirely
+    assert journal.drop_tail(3, 0, 5) == 1
+    assert journal.restart_commands(3, 0) == {}
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: PendingQueue idle-accounting hardening
+# ---------------------------------------------------------------------------
+
+def _exact_live(queue):
+    return sum(1 for e in queue._heap if not e.cancelled and not e.recurring)
+
+
+def test_pending_queue_cancel_after_pop_is_noop():
+    """The round-4 idle-accounting bug class: cancelling an entry that was
+    already popped+executed must not double-decrement `_live_nonrecurring`."""
+    q = PendingQueue()
+    fired = []
+    entry = q.add_after(10, lambda: fired.append(1))
+    other = q.add_after(20, lambda: fired.append(2))
+    assert q.has_nonrecurring()
+    q.pop()()
+    assert fired == [1]
+    entry.cancel()          # already popped: must be a no-op
+    entry.cancel()          # idempotent
+    assert q._live_nonrecurring == _exact_live(q) == 1
+    assert q.has_nonrecurring()
+    other.cancel()
+    assert q._live_nonrecurring == _exact_live(q) == 0
+    assert not q.has_nonrecurring()
+    other.cancel()          # cancel-after-cancel: also a no-op
+    assert q._live_nonrecurring == 0
+
+
+def test_pending_queue_counter_never_negative():
+    """The invariant assertion fires on any double decrement instead of the
+    queue silently claiming idle while real timeouts still pend."""
+    q = PendingQueue()
+    entry = q.add_after(5, lambda: None)
+    entry.cancel()
+    assert q._live_nonrecurring == 0
+    # forcing a second decrement must trip the assertion, not go negative
+    entry.cancelled = False
+    entry.popped = False
+    with pytest.raises(AssertionError):
+        entry.cancel()
+
+
+def test_pending_queue_exact_after_crash_teardown():
+    """Cluster.crash cancels a node's timers/callbacks; the queue's live
+    non-recurring accounting must stay exact (not pinned, not negative)."""
+    cluster = make_cluster(seed=4)
+    res = cluster.nodes[1].coordinate(list_txn([], {k(5): "a"}))
+    # crash node 3 mid-flight with its timers/callbacks live
+    cluster.run_until(lambda: len(cluster.queue) > 0)
+    cluster.crash(3)
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+    assert cluster.run_until(res.is_done, max_tasks=200_000)
+    cluster.run_until_idle()
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+    cluster.restart(3)
+    cluster.run_until_idle()
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: a crashed coordinator's in-flight txn is settled by peers
+# ---------------------------------------------------------------------------
+
+class _HoldAfterPreAccept(LinkConfig):
+    """Drops the coordinator's post-preaccept traffic (simulates dying with
+    the decision not yet announced)."""
+
+    def __init__(self, rng, coordinator):
+        super().__init__(rng)
+        self.coordinator = coordinator
+        self.holding = True
+
+    def action(self, from_node, to_node, message=None):
+        if self.holding and from_node == self.coordinator \
+                and type(message).__name__ in ("Accept", "Commit", "Apply"):
+            return LinkConfig.DROP
+        return LinkConfig.DELIVER
+
+
+def test_crashed_coordinator_superseded_by_peer_recovery():
+    """A node crashes while COORDINATING an in-flight txn (peers saw only
+    PreAccept): the peers' progress logs must settle the txn to a terminal
+    state — committed or invalidated — without the coordinator.  After the
+    node restarts from its journal it converges to the same outcome."""
+    link = _HoldAfterPreAccept(RandomSource(8), 1)
+    cluster = make_cluster(seed=2, link=link)
+    txn = list_txn([], {k(5): "orphan"})
+    cluster.nodes[1].coordinate(txn)
+
+    def witnessed_at_peers():
+        return any(store.commands
+                   for store in cluster.nodes[2].command_stores.all_stores())
+    assert cluster.run_until(witnessed_at_peers, max_tasks=100_000)
+    txn_id = next(iter(
+        cluster.nodes[2].command_stores.all_stores()[0].commands))
+    cluster.crash(1)
+    link.holding = False   # the drops modeled the dead coordinator
+
+    def settled_at_peers():
+        return all(
+            find_command(cluster, n, txn_id) is not None
+            and find_command(cluster, n, txn_id).save_status.is_terminal
+            for n in (2, 3))
+    cluster.run_for(90)
+    assert settled_at_peers(), \
+        f"peers never settled the orphan: " \
+        f"{[find_command(cluster, n, txn_id).save_status for n in (2, 3)]}"
+    statuses = {find_command(cluster, n, txn_id).save_status for n in (2, 3)}
+    assert statuses <= {SaveStatus.APPLIED, SaveStatus.INVALIDATED,
+                        SaveStatus.TRUNCATED_APPLY, SaveStatus.ERASED}
+
+    # the restarted coordinator replays its journal and converges
+    cluster.restart(1)
+    cluster.run_for(60)
+    datas = {n: cluster.stores[n].get(k(5)) for n in cluster.nodes}
+    assert len(set(datas.values())) == 1, f"divergent after restart: {datas}"
+
+
+def test_restarted_replica_catches_up_through_deps():
+    """A replica that was down while writes committed rebuilds from its
+    journal and catches up through the dependency chain of later txns."""
+    cluster = make_cluster(seed=3)
+    for value, down in (("a", False), ("b", True), ("c", False)):
+        if value == "b":
+            cluster.crash(3)
+        elif value == "c":
+            cluster.restart(3)
+        res = cluster.nodes[1].coordinate(list_txn([], {k(5): value}))
+        assert cluster.run_until(res.is_done, max_tasks=500_000), value
+        assert res.is_success(), res.failure
+    cluster.run_for(60)
+    assert cluster.stores[3].get(k(5)) == ("a", "b", "c")
+    for n in (1, 2):
+        assert cluster.stores[n].get(k(5)) == ("a", "b", "c")
+
+
+# ---------------------------------------------------------------------------
+# Stall watchdog: wait-graph dump names the blocked txn ids
+# ---------------------------------------------------------------------------
+
+class _DropApplyTo(LinkConfig):
+    def __init__(self, rng, victim):
+        super().__init__(rng)
+        self.victim = victim
+
+    def action(self, from_node, to_node, message=None):
+        if to_node == self.victim and type(message).__name__ == "Apply":
+            return LinkConfig.DROP
+        return LinkConfig.DELIVER
+
+
+def _stalled_cluster():
+    """Deterministic stall fixture: txn A's Apply never reaches node 3, so a
+    later same-key txn B sits PRE_APPLIED on node 3 waiting on A forever
+    (progress log disabled: nothing heals it)."""
+    shards = [Shard(Range(k(0), k(1000)), [1, 2, 3])]
+    cluster = Cluster(Topology(1, shards), seed=6,
+                      link_config=_DropApplyTo(RandomSource(13), 3),
+                      journal=True, progress_log=False)
+    ra = cluster.nodes[1].coordinate(list_txn([], {k(7): "first"}))
+    assert cluster.run_until(ra.is_done)
+    rb = cluster.nodes[1].coordinate(list_txn([], {k(7): "second"}))
+    assert cluster.run_until(rb.is_done)
+    cluster.run_until_idle()
+    blocked = [
+        (txn_id, cmd)
+        for store in cluster.nodes[3].command_stores.all_stores()
+        for txn_id, cmd in store.commands.items()
+        if cmd.waiting_on is not None and cmd.waiting_on.is_waiting()]
+    assert blocked, "fixture failed to produce a blocked txn on node 3"
+    return cluster, blocked
+
+
+def test_wait_state_dump_names_blocked_txns():
+    cluster, blocked = _stalled_cluster()
+    dump = dump_wait_state(cluster)
+    assert "BLOCKED" in dump
+    for txn_id, cmd in blocked:
+        assert str(txn_id) in dump, f"dump does not name blocked {txn_id}"
+        for dep in cmd.waiting_on.waiting:
+            assert str(dep) in dump, f"dump does not name dependency {dep}"
+    # the per-node status frontier is part of the report
+    assert "frontier=" in dump and "node 3" in dump
+
+
+def test_stall_watchdog_fires_with_dump():
+    """On a deliberately-induced stall the watchdog raises StallError whose
+    dump carries the wait graph (the artifact CI gets instead of a bare
+    `timeout` kill)."""
+    cluster, blocked = _stalled_cluster()
+    watchdog = StallWatchdog(cluster, lambda: 0,
+                             stalled_after_s=5.0, interval_s=1.0)
+    watchdog.attach()
+    with pytest.raises(StallError) as exc:
+        cluster.run_for(30)
+    assert str(blocked[0][0]) in exc.value.dump
+    assert "no progress for" in str(exc.value)
+
+
+def test_stall_watchdog_quiet_while_progressing():
+    """A moving progress counter never trips the watchdog."""
+    cluster = make_cluster(seed=5)
+    ticks = []
+    cluster.scheduler.recurring(1.0, lambda: ticks.append(1))
+    watchdog = StallWatchdog(cluster, lambda: len(ticks),
+                             stalled_after_s=3.0, interval_s=0.5)
+    watchdog.attach()
+    cluster.run_for(30)   # must not raise
+    watchdog.cancel()
+
+
+def test_burn_cli_stall_exits_nonzero(monkeypatch, capsys):
+    """The burn CLI turns a watchdog stall into exit code 2 + the wait-graph
+    dump on stdout — CI artifacts instead of an external timeout kill."""
+    from cassandra_accord_tpu.harness import burn as burn_mod
+
+    def fake_run_burn(seed, **kw):
+        raise SimulationException(
+            seed, StallError("no progress for 120.0s of sim-time",
+                             "node 1 store 0: frontier={}\n"
+                             "  BLOCKED [1,42,1]Wk [STABLE] waiting_on=[[1,7,2]Wk]"))
+    monkeypatch.setattr(burn_mod, "run_burn", fake_run_burn)
+    with pytest.raises(SystemExit) as exc:
+        burn_mod.main(["--seeds", "0", "--ops", "5"])
+    assert exc.value.code == 2
+    out = capsys.readouterr().out
+    assert "STALL" in out and "BLOCKED [1,42,1]Wk" in out
+
+
+# ---------------------------------------------------------------------------
+# Satellite 6: tier-1 restart smoke + the gated restart x hostile matrix
+# ---------------------------------------------------------------------------
+
+def test_restart_smoke_burn():
+    """Fast tier-1 smoke: a benign-network burn with the crash-restart
+    nemesis actually crashing and rebuilding nodes (>=1 full cycle), every
+    op resolving and the final states agreeing."""
+    cfg = restart_config(restart_interval_s=0.3, restart_downtime_min_s=0.2,
+                         restart_downtime_max_s=0.5)
+    result = run_burn(3, ops=40, concurrency=8, journal=True,
+                      restart_nodes=True, node_config=cfg,
+                      max_tasks=5_000_000)
+    assert result.resolved == 40
+    assert result.ops_failed == 0
+    assert result.restarts >= 1, \
+        f"nemesis never completed a crash-restart cycle: {result!r}"
+    assert result.crashes == result.restarts
+
+
+def test_restart_burn_is_deterministic():
+    """Same seed, same crash schedule, same outcome (the nemesis draws from
+    the seeded rng tree like every other fault axis)."""
+    cfg = restart_config(restart_interval_s=0.3, restart_downtime_min_s=0.2,
+                         restart_downtime_max_s=0.5)
+    kw = dict(ops=40, concurrency=8, journal=True, restart_nodes=True,
+              node_config=cfg, max_tasks=5_000_000)
+    a = run_burn(3, **kw)
+    b = run_burn(3, **kw)
+    assert (a.ops_ok, a.ops_recovered, a.ops_nacked, a.ops_lost, a.crashes,
+            a.restarts, a.sim_micros) \
+        == (b.ops_ok, b.ops_recovered, b.ops_nacked, b.ops_lost, b.crashes,
+            b.restarts, b.sim_micros)
+
+
+def test_restart_with_chaos_burn():
+    """One hostile-network seed with restarts in tier-1 (the full matrix is
+    gated behind ACCORD_LONG_BURNS): crash-restart under message loss,
+    recovery resolving orphaned client ops."""
+    cfg = restart_config(restart_interval_s=3.0, restart_downtime_min_s=1.0,
+                         restart_downtime_max_s=3.0)
+    result = run_burn(1, ops=60, concurrency=10, chaos=True,
+                      allow_failures=True, durability=True, journal=True,
+                      restart_nodes=True, node_config=cfg,
+                      max_tasks=20_000_000)
+    assert result.resolved == 60
+    assert result.restarts >= 1
+
+
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="seed-range restart x hostile matrix; run with ACCORD_LONG_BURNS=1")
+def test_restart_hostile_matrix_seed_range():
+    """ISSUE 1 acceptance: >=8 seeds x 200 ops with crash-restart alongside
+    the full hostile matrix (chaos + churn + durability + truncation + clock
+    drift + delayed stores + cache-miss + journal faults), averaging >=1
+    restart per seed, no divergence, no stalls."""
+    cfg = restart_config(restart_interval_s=5.0)
+    total_restarts = 0
+    # seed 6 excluded: the open range-read vs bootstrap-refencing stall
+    # (KNOWN_ISSUES) — it stalls with or without restarts
+    for seed in (0, 1, 2, 3, 4, 5, 7, 8):
+        rf = 2 + RandomSource(seed).next_int(8)
+        result = run_burn(seed, ops=200, concurrency=20, rf=rf, chaos=True,
+                          allow_failures=True, topology_churn=True,
+                          durability=True, journal=True, delayed_stores=True,
+                          clock_drift=True, cache_miss=True,
+                          restart_nodes=True, node_config=cfg,
+                          stall_watchdog_s=300.0, max_tasks=200_000_000)
+        assert result.resolved == 200, result
+        total_restarts += result.restarts
+    assert total_restarts >= 8, \
+        f"averaged <1 restart/seed across the range: {total_restarts}"
+
+
+# ---------------------------------------------------------------------------
+# Satellite 4: frontier-parity open repro (KNOWN_ISSUES, round-6 harness)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="open KNOWN_ISSUES repro; run with ACCORD_LONG_BURNS=1")
+@pytest.mark.xfail(strict=False,
+                   reason="KNOWN_ISSUES: frontier_exec under the FULL hostile "
+                          "matrix trips the device/host frontier parity check "
+                          "(device-only txn whose host WaitingOn still holds "
+                          "an edge) — open for round 6")
+def test_frontier_exec_full_hostile_matrix_parity_repro():
+    run_burn(0, ops=100, concurrency=20, resolver="verify", frontier_exec=True,
+             chaos=True, allow_failures=True, topology_churn=True,
+             durability=True, journal=True, delayed_stores=True,
+             clock_drift=True, cache_miss=True, max_tasks=200_000_000)
