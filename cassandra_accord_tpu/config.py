@@ -56,6 +56,54 @@ class LocalConfig:
     stall_watchdog_interval_s: float = 5.0  # sim-time between progress checks
     stall_watchdog_after_s: float = 120.0   # sim-time with no resolved op => dump
 
+    # -- gray-failure nemeses (harness/nemesis.py) ---------------------------
+    # stop-the-world process pauses: scheduler, sinks and store executors
+    # freeze; every due timer/delivery late-fires in order at resume.  The
+    # cadences are deliberately de-aligned from restart_interval_s (20) and
+    # the 5s chaos re-roll so fault classes overlap at seeded, shifting phases
+    pause_interval_s: float = 15.0          # mean sim-time between pause attempts
+    pause_min_s: float = 0.5                # min stop-the-world duration
+    pause_max_s: float = 4.0                # max (> reply timeout: peers MUST
+                                            # observe the node as slow-not-dead)
+    pause_max_paused: int = 1               # max concurrently-paused nodes
+    pause_keep_quorum: bool = True          # count paused as unavailable for
+                                            # the crash/pause quorum floor
+    # journal-append stalls: durability (and therefore every outbound reply —
+    # fsync-before-reply) lags execution; a crash mid-stall loses the whole
+    # unsynced tail
+    disk_stall_interval_s: float = 17.0     # mean sim-time between stall attempts
+    disk_stall_min_s: float = 1.0
+    disk_stall_max_s: float = 6.0
+
+    # -- journal integrity (harness/journal.py) ------------------------------
+    # crash-time damage injection (restart nemesis): probability a crash tears
+    # the tail record (partial write) / bit-flips a random record
+    journal_torn_tail_chance: float = 0.25
+    journal_corrupt_chance: float = 0.15
+    # what restart replay does with a checksum-failed MID-LOG record (a torn
+    # TAIL always silently truncates to the last whole record, like any WAL):
+    # "quarantine" drops the damaged txn's records and re-enters the bootstrap
+    # catch-up ladder over its footprint; "halt" raises JournalCorruption loud
+    journal_corruption_policy: str = "quarantine"
+
+    # -- adaptive reply timeout/backoff (harness/cluster.py sink) ------------
+    # the first timeout is reply_timeout_s; every non-final-reply re-arm grows
+    # by reply_backoff_factor (capped, with deterministic hash jitter so
+    # re-arms across nodes never phase-lock), and after reply_rearm_budget
+    # re-arms the last armed timer stands un-re-armed (bounded patience)
+    reply_backoff_factor: float = 2.0
+    reply_backoff_max_s: float = 30.0
+    reply_backoff_jitter: float = 0.25      # fraction of the timeout, [0, j)
+    reply_rearm_budget: int = 8
+
+    # -- slow-replica tracking (read-speculation routing) --------------------
+    # a peer is "slow" while its reply-latency EWMA exceeds the threshold or
+    # within the penalty window after a reply timeout; coordinators route
+    # per-shard data reads around slow peers instead of burning timeout rounds
+    slow_peer_ewma_alpha: float = 0.3
+    slow_peer_latency_threshold_s: float = 1.0
+    slow_peer_penalty_s: float = 5.0
+
     # -- deps-resolver data plane (impl/resolver.py, impl/tpu_resolver.py) ---
     resolver_kind: str = "cpu"              # cpu | tpu | verify
     tpu_txn_slots: int = 64
@@ -73,6 +121,15 @@ class LocalConfig:
         ("ACCORD_RESTART_DOWNTIME_MAX", "restart_downtime_max_s", float),
         ("ACCORD_RESTART_MAX_DOWN", "restart_max_down", int),
         ("ACCORD_STALL_WATCHDOG_AFTER", "stall_watchdog_after_s", float),
+        ("ACCORD_PAUSE_INTERVAL", "pause_interval_s", float),
+        ("ACCORD_PAUSE_MAX", "pause_max_s", float),
+        ("ACCORD_DISK_STALL_INTERVAL", "disk_stall_interval_s", float),
+        ("ACCORD_JOURNAL_CORRUPTION", "journal_corruption_policy",
+         lambda v: v.lower()),
+        ("ACCORD_JOURNAL_TORN_TAIL_CHANCE", "journal_torn_tail_chance", float),
+        ("ACCORD_JOURNAL_CORRUPT_CHANCE", "journal_corrupt_chance", float),
+        ("ACCORD_REPLY_BACKOFF_MAX", "reply_backoff_max_s", float),
+        ("ACCORD_REPLY_REARM_BUDGET", "reply_rearm_budget", int),
         ("ACCORD_RESOLVER", "resolver_kind", lambda v: v.lower()),
         ("ACCORD_TPU_TXN_SLOTS", "tpu_txn_slots", int),
         ("ACCORD_TPU_KEY_SLOTS", "tpu_key_slots", int),

@@ -327,7 +327,8 @@ class _ExecuteTxn:
             # (deterministically chosen) stuck copy every round re-creates
             # the livelock the rounds exist to break
             for to in self.read_tracker.initial_contacts(
-                    prefer=self.node.id, rotate=self.read_rounds):
+                    prefer=self.node.id, rotate=self.read_rounds,
+                    avoid=self.node.slow_peers()):
                 self.send_read_retry(to)
             self._arm_read_speculation()   # retry rounds speculate too
         delay = cfg.read_retry_delay_s if cfg is not None else 0.15
@@ -342,7 +343,11 @@ class _ExecuteTxn:
         return self.txn.read is not None and not self.txn_id.kind.is_sync_point
 
     def start(self) -> None:
-        read_nodes = set(self.read_tracker.initial_contacts(prefer=self.node.id)) \
+        # route the per-shard data reads around peers the gray-failure
+        # tracker currently marks slow (paused-but-alive, stalled disk):
+        # contacting one burns a whole reply-timeout + speculation round
+        read_nodes = set(self.read_tracker.initial_contacts(
+            prefer=self.node.id, avoid=self.node.slow_peers())) \
             if self.needs_read else set()
         this = self
 
@@ -362,7 +367,8 @@ class _ExecuteTxn:
                                     is RequestStatus.SUCCESS:
                                 this.maybe_finish()
                             return
-                        status, retries = this.read_tracker.record_read_failure(from_node)
+                        status, retries = this.read_tracker.record_read_failure(
+                            from_node, avoid=this.node.slow_peers())
                         if status is RequestStatus.FAILED:
                             this.retry_read_round_or_fail()
                             return
@@ -378,7 +384,8 @@ class _ExecuteTxn:
                         # bootstrapping replica, or one that raced past
                         # ReadyToExecute (an Apply won): read elsewhere
                         # (the Stable part already acked separately)
-                        status, retries = this.read_tracker.record_read_failure(from_node)
+                        status, retries = this.read_tracker.record_read_failure(
+                            from_node, avoid=this.node.slow_peers())
                         if status is RequestStatus.FAILED:
                             this.retry_read_round_or_fail()
                             return
@@ -408,7 +415,8 @@ class _ExecuteTxn:
                     return
                 if not this.needs_read:
                     return
-                status, retries = this.read_tracker.record_read_failure(from_node)
+                status, retries = this.read_tracker.record_read_failure(
+                    from_node, avoid=this.node.slow_peers())
                 if status is RequestStatus.FAILED:
                     this.done = True
                     this.result.set_failure(Exhausted(this.txn_id, "read"))
@@ -440,7 +448,8 @@ class _ExecuteTxn:
         def fire():
             if self.done:
                 return
-            for to in self.read_tracker.speculate():
+            for to in self.read_tracker.speculate(
+                    avoid=self.node.slow_peers()):
                 self.send_read_retry(to)
         self.node.scheduler.once(delay, fire)
 

@@ -136,7 +136,8 @@ class _CoordinateEphemeralRead:
                 self._retry(from_node)
 
             def _retry(self, from_node: int) -> None:
-                status, retries = read_tracker.record_read_failure(from_node)
+                status, retries = read_tracker.record_read_failure(
+                    from_node, avoid=this.node.slow_peers())
                 if status is RequestStatus.FAILED:
                     data_holder["done"] = True
                     this.result.set_failure(Exhausted(this.txn_id, "ephemeral read"))
@@ -148,7 +149,8 @@ class _CoordinateEphemeralRead:
 
         callback = ReadCallback()
         callback.callback_ref = callback
-        for to in read_tracker.initial_contacts(prefer=self.node.id):
+        for to in read_tracker.initial_contacts(prefer=self.node.id,
+                                                avoid=self.node.slow_peers()):
             req = self._read_request_for(to, deps)
             if req is not None:
                 self.node.send(to, req, callback)

@@ -174,27 +174,42 @@ class ReadTracker(AbstractTracker):
         self._contacted: Set[int] = set()
 
     def initial_contacts(self, prefer: Optional[int] = None,
-                         rotate: int = 0) -> List[int]:
+                         rotate: int = 0,
+                         avoid: frozenset = frozenset()) -> List[int]:
         """Pick one replica per shard (preferring ``prefer`` — normally self).
 
         ``rotate`` shifts EVERY shard's pick index by that many positions, so
         retry rounds contact a different replica per shard — a global
-        preferred node only rotates shards that contain it."""
+        preferred node only rotates shards that contain it.
+
+        ``avoid`` holds replicas the coordinator's gray-failure tracker
+        currently marks slow (paused-but-alive, stalled-disk, saturated):
+        the pick shifts past them when ANY non-slow alternative exists, so a
+        known-slow replica never costs a whole timeout/speculation round.
+        When every replica of a shard is marked slow, the base pick stands —
+        avoidance must never starve a shard of its read."""
         out: Set[int] = set()
         for t in self.trackers:
             nodes = t.shard.nodes
             base = nodes.index(prefer) if prefer in nodes else 0
             pick = nodes[(base + rotate) % len(nodes)]
+            if avoid and pick in avoid:
+                for off in range(1, len(nodes)):
+                    alt = nodes[(base + rotate + off) % len(nodes)]
+                    if alt not in avoid:
+                        pick = alt
+                        break
             t.in_flight_reads.add(pick)
             out.add(pick)
         self._contacted.update(out)
         return sorted(out)
 
-    def speculate(self) -> List[int]:
+    def speculate(self, avoid: frozenset = frozenset()) -> List[int]:
         """Slow-replica speculation (ReadTracker.java's slow/insufficient
         ladder): for each shard still awaiting data, contact ONE additional
         untried replica WITHOUT failing the in-flight one — a slow replica
-        costs only the duplicate read, not a whole reply-timeout round."""
+        costs only the duplicate read, not a whole reply-timeout round.
+        Known-slow candidates (``avoid``) are picked last."""
         extra: Set[int] = set()
         for t in self.trackers:
             if t.data_received:
@@ -203,7 +218,8 @@ class ReadTracker(AbstractTracker):
                           if n not in t.failures
                           and n not in t.in_flight_reads]
             if candidates:
-                pick = candidates[0]
+                pick = next((n for n in candidates if n not in avoid),
+                            candidates[0])
                 t.in_flight_reads.add(pick)
                 extra.add(pick)
         self._contacted.update(extra)
@@ -218,8 +234,11 @@ class ReadTracker(AbstractTracker):
             return RequestStatus.SUCCESS
         return RequestStatus.NO_CHANGE
 
-    def record_read_failure(self, node: int) -> Tuple[RequestStatus, List[int]]:
-        """Returns (status, additional nodes to contact)."""
+    def record_read_failure(self, node: int,
+                            avoid: frozenset = frozenset()) \
+            -> Tuple[RequestStatus, List[int]]:
+        """Returns (status, additional nodes to contact).  Replacement picks
+        prefer replicas NOT currently marked slow (``avoid``)."""
         retries: Set[int] = set()
         for t in self.trackers_for(node):
             t.in_flight_reads.discard(node)
@@ -230,7 +249,8 @@ class ReadTracker(AbstractTracker):
                           if n not in t.failures and n not in t.in_flight_reads]
             if not candidates:
                 return RequestStatus.FAILED, []
-            pick = candidates[0]
+            pick = next((n for n in candidates if n not in avoid),
+                        candidates[0])
             t.in_flight_reads.add(pick)
             retries.add(pick)
         self._contacted.update(retries)

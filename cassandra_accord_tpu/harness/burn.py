@@ -41,6 +41,8 @@ class BurnResult:
         self.ops_failed = 0      # unexpected failure
         self.crashes = 0         # nemesis node kills
         self.restarts = 0        # journal-replay rebuilds
+        self.pauses = 0          # stop-the-world process pauses
+        self.disk_stalls = 0     # journal-append stalls
         self.sim_micros = 0
         self.stats: Dict[str, int] = {}
 
@@ -51,10 +53,12 @@ class BurnResult:
 
     def __repr__(self):
         restarts = f", restarts={self.restarts}" if self.restarts else ""
+        pauses = f", pauses={self.pauses}" if self.pauses else ""
+        stalls = f", disk_stalls={self.disk_stalls}" if self.disk_stalls else ""
         return (f"BurnResult(seed={self.seed}, ok={self.ops_ok}, "
                 f"recovered={self.ops_recovered}, nacked={self.ops_nacked}, "
-                f"lost={self.ops_lost}, failed={self.ops_failed}{restarts}, "
-                f"sim_ms={self.sim_micros // 1000})")
+                f"lost={self.ops_lost}, failed={self.ops_failed}{restarts}"
+                f"{pauses}{stalls}, sim_ms={self.sim_micros // 1000})")
 
 
 class SimulationException(Exception):
@@ -130,6 +134,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              cache_miss: bool = False,
              frontier_exec: bool = False,
              restart_nodes: bool = False,
+             pause_nodes: bool = False,
+             disk_stall: bool = False,
              stall_watchdog_s: Optional[float] = None,
              node_config=None,
              max_tasks: int = 20_000_000,
@@ -142,7 +148,16 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
 
     ``restart_nodes=True`` adds the crash-restart nemesis (harness/nemesis.py):
     seeded node kills + journal-replay rebuilds, cadence/downtime/concurrency
-    from LocalConfig (``node_config`` or env).  Requires ``journal=True``.
+    from LocalConfig (``node_config`` or env) — including crash-time journal
+    damage injection (torn tails, bit flips) the restart replay must detect
+    and absorb.  Requires ``journal=True``.
+
+    ``pause_nodes=True`` adds the pause nemesis: seeded stop-the-world
+    process pauses; every frozen timer late-fires at resume.
+
+    ``disk_stall=True`` adds the disk-stall nemesis: journal-append stalls
+    (durability + outbound packets lag execution); a crash mid-stall loses
+    the unsynced tail.  Requires ``journal=True``.
 
     ``stall_watchdog_s``: raise StallError with a full wait-graph dump after
     this much sim-time without a resolved op (None disables).
@@ -501,8 +516,30 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             downtime_max_s=cfg.restart_downtime_max_s,
             max_down=cfg.restart_max_down,
             keep_quorum=cfg.restart_keep_quorum,
+            torn_tail_chance=cfg.journal_torn_tail_chance,
+            corrupt_chance=cfg.journal_corrupt_chance,
             on_crash=fail_over_orphans)
         nemesis.attach()
+    pause_nemesis = None
+    if pause_nodes:
+        from .nemesis import PauseNemesis
+        pause_nemesis = PauseNemesis(
+            cluster, rng.fork(),
+            interval_s=cfg.pause_interval_s,
+            pause_min_s=cfg.pause_min_s, pause_max_s=cfg.pause_max_s,
+            max_paused=cfg.pause_max_paused,
+            keep_quorum=cfg.pause_keep_quorum)
+        pause_nemesis.attach()
+    disk_nemesis = None
+    if disk_stall:
+        assert journal, "disk_stall requires journal=True (the stalled device)"
+        from .nemesis import DiskStallNemesis
+        disk_nemesis = DiskStallNemesis(
+            cluster, rng.fork(),
+            interval_s=cfg.disk_stall_interval_s,
+            stall_min_s=cfg.disk_stall_min_s,
+            stall_max_s=cfg.disk_stall_max_s)
+        disk_nemesis.attach()
     watchdog = None
     if stall_watchdog_s is not None:
         from .watchdog import StallWatchdog
@@ -520,6 +557,13 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             watchdog.cancel()   # resolved stops moving by design from here on
         if churn_task is not None:
             churn_task.cancel()
+        if pause_nemesis is not None:
+            # resume every paused node BEFORE restarting downed ones: the
+            # parked late-firing timers must drain into a full replica set
+            pause_nemesis.stop_and_restore()
+        if disk_nemesis is not None:
+            # everything buffered becomes durable; held packets hit the wire
+            disk_nemesis.stop_and_restore()
         if nemesis is not None:
             # restore every down node BEFORE judging final state: the
             # agreement checks need the full replica set live and caught up
@@ -569,6 +613,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         result.stats = dict(cluster.stats)
         result.crashes = cluster.stats.get("node_crashes", 0)
         result.restarts = cluster.stats.get("node_restarts", 0)
+        result.pauses = cluster.stats.get("node_pauses", 0)
+        result.disk_stalls = cluster.stats.get("journal_stalls", 0)
         # per-key execution-register inversion diagnostic (TimestampsForKey):
         # surfaced in every burn's stats; MUST be 0 in benign runs (asserted
         # by test_timestamps_for_key) — growth under chaos pages the Agent
@@ -690,9 +736,29 @@ def main(argv=None) -> None:
                    help="disable the crash-restart nemesis (node kills + "
                         "journal-replay rebuilds are part of the default "
                         "hostile matrix)")
+    p.add_argument("--no-pause", action="store_true",
+                   help="disable the pause nemesis (stop-the-world process "
+                        "pauses with late-firing timers are part of the "
+                        "default hostile matrix)")
+    p.add_argument("--no-disk-stall", action="store_true",
+                   help="disable the disk-stall nemesis (journal-append "
+                        "stalls; a crash mid-stall loses the unsynced tail)")
+    p.add_argument("--no-corruption", action="store_true",
+                   help="disable crash-time journal damage injection "
+                        "(torn tail records, bit flips)")
+    p.add_argument("--corruption-policy", default=None,
+                   choices=["quarantine", "halt"],
+                   help="restart-replay policy for a corrupt MID-LOG record "
+                        "(default: LocalConfig/ACCORD_JOURNAL_CORRUPTION)")
     p.add_argument("--restart-interval", type=float, default=None,
                    help="mean sim-seconds between crash attempts "
                         "(default: LocalConfig/ACCORD_RESTART_INTERVAL)")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write a machine-readable per-seed summary "
+                        "(pass/stall/divergence, wall-clock, ops resolved, "
+                        "faults injected) after every seed — seed-range "
+                        "matrix runs diff across PRs instead of eyeballing "
+                        "logs")
     p.add_argument("--no-watchdog", action="store_true",
                    help="disable the stall watchdog (on stall it dumps the "
                         "wait graph + status frontier and exits nonzero)")
@@ -702,18 +768,40 @@ def main(argv=None) -> None:
     p.add_argument("--reconcile", action="store_true",
                    help="double-run each seed and diff full traces")
     args = p.parse_args(argv)
+    from dataclasses import replace as _replace
     from ..config import LocalConfig
     from .watchdog import StallError
     cfg = LocalConfig.from_env()
     if args.restart_interval is not None:
-        from dataclasses import replace as _replace
         cfg = _replace(cfg, restart_interval_s=args.restart_interval)
+    if args.no_corruption:
+        cfg = _replace(cfg, journal_torn_tail_chance=0.0,
+                       journal_corrupt_chance=0.0)
+    if args.corruption_policy is not None:
+        cfg = _replace(cfg, journal_corruption_policy=args.corruption_policy)
     watchdog_s = None
     if not args.no_watchdog:
         watchdog_s = args.watchdog_stall if args.watchdog_stall is not None \
             else cfg.stall_watchdog_after_s
     lo, _, hi = args.seeds.partition(":")
     seeds = range(int(lo), int(hi) + 1) if hi else [int(lo)]
+    summaries: list = []
+
+    def write_json() -> None:
+        if args.json is None:
+            return
+        import json as _json
+        doc = {"ops": args.ops, "concurrency": args.concurrency,
+               "seeds": args.seeds, "benign": args.benign,
+               "results": summaries}
+        with open(args.json, "w") as f:
+            _json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    _FAULT_KEYS = ("node_crashes", "node_restarts", "node_pauses",
+                   "journal_stalls", "journal_unsynced_lost",
+                   "journal_injected_tears", "journal_injected_bitflips",
+                   "journal_torn_records", "journal_quarantined_txns")
     for seed in seeds:
         rf = args.rf if args.rf is not None else 2 + RandomSource(seed).next_int(8)
         kw = dict(ops=args.ops, concurrency=args.concurrency, rf=rf,
@@ -724,20 +812,47 @@ def main(argv=None) -> None:
                   delayed_stores=not args.benign, clock_drift=not args.benign,
                   cache_miss=not args.no_cache_miss,
                   restart_nodes=not args.no_restart,
+                  pause_nodes=not args.no_pause,
+                  disk_stall=not args.no_disk_stall,
                   stall_watchdog_s=watchdog_s,
                   node_config=cfg,
                   max_tasks=200_000_000)
         t0 = _time.perf_counter()
+        entry = {"seed": seed, "rf": rf, "ops": args.ops}
+        summaries.append(entry)
         try:
             if args.reconcile:
                 reconcile(seed, **kw)
+                entry.update(status="pass", reconciled=True,
+                             wall_s=round(_time.perf_counter() - t0, 3))
+                write_json()
                 print(f"seed {seed}: reconciled (rf={rf}, "
                       f"{_time.perf_counter() - t0:.1f}s)")
             else:
                 result = run_burn(seed, **kw)
+                entry.update(
+                    status="pass", wall_s=round(_time.perf_counter() - t0, 3),
+                    resolved=result.resolved, ok=result.ops_ok,
+                    recovered=result.ops_recovered, nacked=result.ops_nacked,
+                    lost=result.ops_lost, failed=result.ops_failed,
+                    sim_ms=result.sim_micros // 1000,
+                    faults={k: result.stats[k] for k in _FAULT_KEYS
+                            if result.stats.get(k)})
+                write_json()
                 print(f"seed {seed}: {result!r} (rf={rf}, "
                       f"{_time.perf_counter() - t0:.1f}s)")
         except SimulationException as e:
+            if isinstance(e.cause, StallError):
+                status = "stall"
+            elif isinstance(e.cause, HistoryViolation) \
+                    and "divergence" in str(e.cause):
+                status = "divergence"
+            else:
+                status = "fail"
+            entry.update(status=status,
+                         wall_s=round(_time.perf_counter() - t0, 3),
+                         error=str(e.cause)[:2000])
+            write_json()
             if isinstance(e.cause, StallError):
                 # actionable stall artifact for CI / seed-range sweeps: the
                 # wait-graph + status-frontier dump, then a nonzero exit —
@@ -746,6 +861,7 @@ def main(argv=None) -> None:
                       f"{_time.perf_counter() - t0:.1f}s\n{e.cause.dump}")
                 raise SystemExit(2)
             raise
+    write_json()
 
 
 if __name__ == "__main__":

@@ -78,22 +78,28 @@ class RandomizedLinkConfig(LinkConfig):
         self.overrides: Dict[Tuple[int, int], _LinkOverride] = {}
         self.healed = False
         self._nodes: List[int] = []
+        self._task = None
 
     # -- wiring ---------------------------------------------------------------
     def attach(self, cluster: Cluster) -> None:
         """Register the re-randomization task on the cluster queue (the chaos
-        recurring task, Cluster.java:455-459)."""
+        recurring task, Cluster.java:455-459), retaining the handle so
+        ``heal`` can CANCEL it — the ``healed`` no-op guard alone left the
+        reroll firing (and drawing rng) forever after quiesce."""
         self._nodes = sorted(cluster.nodes)
 
         def reroll():
             if not self.healed:
                 self.randomize()
 
-        cluster.scheduler.recurring(self.interval_s, reroll)
+        self._task = cluster.scheduler.recurring(self.interval_s, reroll)
         self.randomize()
 
     def heal(self) -> None:
         self.healed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
         self.partitioned = frozenset()
         self.overrides = {}
 

@@ -106,6 +106,56 @@ class PendingQueue:
             return (self.at, self.seq) < (other.at, other.seq)
 
 
+def backoff_timeout_us(base_s: float, attempt: int, factor: float, max_s: float,
+                       jitter_frac: float, salt: int) -> int:
+    """Exponential reply-timeout backoff with DETERMINISTIC jitter: the jitter
+    comes from a golden-ratio hash of (salt=msg_id, attempt), not from any
+    rng — no seeded stream is consumed, so every trajectory stays replayable
+    while re-arms across nodes never phase-lock."""
+    t = min(base_s * (factor ** attempt), max_s)
+    h = (salt * 0x9E3779B97F4A7C15 + (attempt + 1) * 0xD1B54A32D192ED03) \
+        & 0xFFFFFFFFFFFFFFFF
+    t *= 1.0 + jitter_frac * ((h >> 40) / float(1 << 24))
+    return int(t * 1_000_000)
+
+
+class SlowReplicaTracker:
+    """Per-node gray-failure detector: reply-latency EWMA plus a penalty
+    window after each reply timeout.  Coordinators consult ``slow_peers`` to
+    route per-shard data reads around paused-but-alive peers instead of
+    burning whole reply-timeout rounds on them (ReadTracker.java's slow
+    ladder, fed by observed behavior instead of a static preference)."""
+
+    __slots__ = ("cluster", "alpha", "threshold_us", "penalty_us", "ewma",
+                 "slow_until")
+
+    def __init__(self, cluster: "Cluster", alpha: float, threshold_s: float,
+                 penalty_s: float):
+        self.cluster = cluster
+        self.alpha = alpha
+        self.threshold_us = threshold_s * 1_000_000
+        self.penalty_us = int(penalty_s * 1_000_000)
+        self.ewma: Dict[int, float] = {}
+        self.slow_until: Dict[int, int] = {}
+
+    def record_reply(self, peer: int, latency_us: int) -> None:
+        prev = self.ewma.get(peer)
+        self.ewma[peer] = latency_us if prev is None \
+            else prev + self.alpha * (latency_us - prev)
+
+    def record_timeout(self, peer: int) -> None:
+        self.slow_until[peer] = self.cluster.queue.now_micros + self.penalty_us
+
+    def is_slow(self, peer: int) -> bool:
+        if self.ewma.get(peer, 0.0) > self.threshold_us:
+            return True
+        return self.cluster.queue.now_micros < self.slow_until.get(peer, -1)
+
+    def slow_peers(self) -> frozenset:
+        return frozenset(p for p in set(self.ewma) | set(self.slow_until)
+                         if self.is_slow(p))
+
+
 class SimScheduler(Scheduler):
     def __init__(self, queue: PendingQueue):
         self.queue = queue
@@ -172,13 +222,19 @@ class NodeScheduler(Scheduler):
         self._entries.clear()
 
     def once(self, delay_s: float, run: Callable[[], None]):
-        holder = {}
+        holder = {"cancelled": False}
 
         def guarded():
+            # stop-the-world pause: the timer is DUE but the process is not
+            # scheduling — park it; it late-fires (in order) at resume.  The
+            # cancelled flag must be re-checked then: cancel() after the park
+            # can no longer reach the popped queue entry
+            if self.cluster._gate(self.node_id, guarded):
+                return
             entry = holder.get("e")
             if entry is not None:
                 self._entries.discard(entry)
-            if self.is_live():
+            if not holder["cancelled"] and self.is_live():
                 run()
 
         entry = self.cluster.queue.add_after(int(delay_s * 1_000_000), guarded)
@@ -188,6 +244,7 @@ class NodeScheduler(Scheduler):
 
         class _S(Scheduler.Scheduled):
             def cancel(self_inner):
+                holder["cancelled"] = True
                 entries.discard(entry)
                 entry.cancel()
         return _S()
@@ -195,10 +252,22 @@ class NodeScheduler(Scheduler):
     def recurring(self, interval_s, run: Callable[[], None]):
         """SimScheduler's resample/fire/re-arm machinery, plus the incarnation
         gate: a dead node's cadence no-ops and cancels itself at its first
-        post-crash fire (one orphan re-arm, then the queue forgets it)."""
-        holder = {}
+        post-crash fire (one orphan re-arm, then the queue forgets it).
+        While the node is PAUSED, fires coalesce: at most one parked instance
+        late-fires at resume (a frozen process's periodic timer doesn't burst
+        one fire per missed period)."""
+        holder = {"parked": False}
+
+        def late_fire():
+            holder["parked"] = False
+            guarded()
 
         def guarded():
+            if self.node_id in self.cluster.paused:
+                if not holder["parked"]:
+                    holder["parked"] = True
+                    self.cluster._gate(self.node_id, late_fire)
+                return
             if self.is_live():
                 run()
             elif holder.get("s") is not None:
@@ -237,8 +306,12 @@ class SimMessageSink(MessageSink):
     def __init__(self, node_id: int, cluster: "Cluster"):
         self.node_id = node_id
         self.cluster = cluster
-        # msg_id -> (callback, timeout_entry, to_node)
-        self.callbacks: Dict[int, Tuple[Callback, object, int]] = {}
+        # msg_id -> (callback, timeout_entry, to_node, rearm_attempt, sent_at)
+        self.callbacks: Dict[int, Tuple[Callback, object, int, int, int]] = {}
+        # gray-failure detector feeding read-speculation routing
+        alpha, threshold_s, penalty_s = cluster.slow_peer_params
+        self.slow_replicas = SlowReplicaTracker(cluster, alpha, threshold_s,
+                                                penalty_s)
 
     def is_live(self) -> bool:
         """A sink belonging to a crashed (or replaced-by-restart) incarnation
@@ -249,9 +322,21 @@ class SimMessageSink(MessageSink):
     def teardown(self) -> None:
         """Crash path: drop every registered callback and cancel its timeout
         entry (exact idle accounting — the timers must not pin the queue)."""
-        for _callback, timeout_entry, _to in self.callbacks.values():
+        for _callback, timeout_entry, _to, _attempt, _sent in \
+                self.callbacks.values():
             timeout_entry.cancel()
         self.callbacks.clear()
+
+    def _arm_timeout(self, msg_id: int, attempt: int):
+        """Arm (or re-arm) the reply timeout for ``msg_id``.  attempt 0 is the
+        flat base timeout; every non-final-reply re-arm backs off
+        exponentially with deterministic jitter (adaptive patience: a node
+        that keeps proving liveness earns longer — but bounded — waits)."""
+        cluster = self.cluster
+        timeout_us = backoff_timeout_us(
+            cluster.reply_timeout_s, attempt, cluster.reply_backoff_factor,
+            cluster.reply_backoff_max_s, cluster.reply_backoff_jitter, msg_id)
+        return cluster.queue.add_after(timeout_us, lambda: self._timeout(msg_id))
 
     # -- outbound -----------------------------------------------------------
     def send(self, to: int, request: Request) -> None:
@@ -268,10 +353,23 @@ class SimMessageSink(MessageSink):
         msg_id = self.cluster.alloc_msg_id()
         cluster = self.cluster
         if callback is not None:
-            timeout_us = int(cluster.reply_timeout_s * 1_000_000)
-            entry = cluster.queue.add_after(timeout_us, lambda: self._timeout(msg_id))
-            self.callbacks[msg_id] = (callback, entry, to)
-        cluster.route(self.node_id, to, request, msg_id, callback is not None)
+            entry = self._arm_timeout(msg_id, 0)
+            self.callbacks[msg_id] = (callback, entry, to, 0,
+                                      cluster.queue.now_micros)
+
+        def emit():
+            cluster.route(self.node_id, to, request, msg_id,
+                          callback is not None)
+        # journal-append stall = fsync-before-reply: a node whose durable
+        # write path is stalled cannot put NEW packets on the wire (its own
+        # timers above still run — the process believes it sent).  Held
+        # packets drain at unstall; a crash mid-stall loses them with the
+        # unsynced journal tail, so no peer ever observed non-durable state
+        if to != self.node_id and cluster.journal is not None \
+                and cluster.journal.is_stalled(self.node_id):
+            cluster.hold_send(self.node_id, emit)
+        else:
+            emit()
 
     def reply(self, to: int, reply_context, reply: Reply) -> None:
         from ..messages.base import LOCAL_NO_REPLY
@@ -279,24 +377,47 @@ class SimMessageSink(MessageSink):
             return   # self-delivered local request: nothing to answer
         if not self.is_live():
             return   # dead incarnation: replies die with the process
-        self.cluster.route_reply(self.node_id, to, reply_context, reply)
+        cluster = self.cluster
+
+        def emit():
+            cluster.route_reply(self.node_id, to, reply_context, reply)
+        if to != self.node_id and cluster.journal is not None \
+                and cluster.journal.is_stalled(self.node_id):
+            cluster.hold_send(self.node_id, emit)
+        else:
+            emit()
 
     # -- inbound correlation -------------------------------------------------
     def deliver_reply(self, from_node: int, msg_id: int, reply: Reply) -> None:
         entry = self.callbacks.get(msg_id)
         if entry is None:
             return
-        callback, timeout_entry, to = entry
-        timeout_entry.cancel()
+        callback, timeout_entry, to, attempt, sent_at = entry
+        now = self.cluster.queue.now_micros
+        # per-LEG latency (send→first reply, reply→reply): measuring from the
+        # original send would fold a txn's whole dependency wait into the
+        # peer's "latency" and mark healthy-but-working replicas slow
+        self.slow_replicas.record_reply(from_node, now - sent_at)
         if reply.is_final:
+            timeout_entry.cancel()
             del self.callbacks[msg_id]
-        else:
+        elif attempt + 1 < self.cluster.reply_rearm_budget:
             # non-final reply (e.g. StableAck before a long dependency wait):
-            # keep the callback registered and re-arm the timeout so a lost final
-            # reply still triggers the failure/retry path
-            timeout_us = int(self.cluster.reply_timeout_s * 1_000_000)
-            new_entry = self.cluster.queue.add_after(timeout_us, lambda: self._timeout(msg_id))
-            self.callbacks[msg_id] = (callback, new_entry, to)
+            # keep the callback registered and re-arm the timeout — backed
+            # off, so a long-but-live dependency wait isn't hammered — and a
+            # lost final reply still triggers the failure/retry path
+            timeout_entry.cancel()
+            new_entry = self._arm_timeout(msg_id, attempt + 1)
+            self.callbacks[msg_id] = (callback, new_entry, to, attempt + 1,
+                                      now)
+        else:
+            # re-arm budget exhausted — deliver the reply below but leave the
+            # LAST armed timer standing; when it fires, the normal timeout
+            # path reports failure and the coordinator's retry machinery
+            # takes over from fresher information (bounded patience, never a
+            # hang)
+            self.callbacks[msg_id] = (callback, timeout_entry, to, attempt,
+                                      now)
         try:
             if isinstance(reply, FailureReply):
                 callback.on_failure(from_node, reply.failure)
@@ -306,10 +427,13 @@ class SimMessageSink(MessageSink):
             callback.on_callback_failure(from_node, e)
 
     def report_failure(self, msg_id: int, to_node: int, failure: BaseException) -> None:
+        if self.cluster._gate(self.node_id, lambda: self.report_failure(
+                msg_id, to_node, failure)):
+            return   # paused process: the failure surfaces at resume
         entry = self.callbacks.pop(msg_id, None)
         if entry is None:
             return
-        callback, timeout_entry, _ = entry
+        callback, timeout_entry, _, _attempt, _sent = entry
         timeout_entry.cancel()
         try:
             callback.on_failure(to_node, failure)
@@ -317,10 +441,17 @@ class SimMessageSink(MessageSink):
             callback.on_callback_failure(to_node, e)
 
     def _timeout(self, msg_id: int) -> None:
+        # a PAUSED process's timers are frozen: the timeout parks and
+        # late-fires at resume (where the reply may by then have raced it in
+        # — the park list preserves order, so the reply wins if it arrived
+        # first, exactly like a real post-pause timer storm)
+        if self.cluster._gate(self.node_id, lambda: self._timeout(msg_id)):
+            return
         entry = self.callbacks.pop(msg_id, None)
         if entry is None:
             return
-        callback, _timeout_entry, to = entry
+        callback, _timeout_entry, to, _attempt, _sent = entry
+        self.slow_replicas.record_timeout(to)
         try:
             callback.on_failure(to, Timeout(None, f"no reply from {to}"))
         except BaseException as e:  # noqa: BLE001
@@ -374,6 +505,8 @@ class SimConfigService(ConfigurationService):
     def deliver_pending(self) -> None:
         """Deliver every not-yet-delivered epoch, in order (TopologyManager
         requires consecutive epochs)."""
+        if self.cluster._gate(self.node_id, self.deliver_pending):
+            return   # paused process: epoch learning resumes with it
         node = self.cluster.nodes.get(self.node_id)
         if node is None or node.config_service is not self:
             return   # node crashed (or this service belongs to a dead incarnation)
@@ -413,7 +546,8 @@ class DelayedAgentExecutor:
     (DelayedCommandStores.DelayedCommandStore, DelayedCommandStores.java:138-195)."""
 
     def __init__(self, agent: Agent, queue: PendingQueue, rng: RandomSource,
-                 max_delay_us: int = 1_000, is_live: Optional[Callable[[], bool]] = None):
+                 max_delay_us: int = 1_000, is_live: Optional[Callable[[], bool]] = None,
+                 pause_gate: Optional[Callable[[Callable], bool]] = None):
         self.agent = agent
         self.queue = queue
         self.rng = rng
@@ -421,9 +555,14 @@ class DelayedAgentExecutor:
         # crash gate: a queued store task belonging to a crashed node
         # incarnation must not run against the torn-down store
         self.is_live = is_live
+        # pause gate: a queued store task of a PAUSED node parks and
+        # late-fires at resume (Cluster._gate)
+        self.pause_gate = pause_gate
 
     def execute(self, task: Callable[[], None]) -> None:
         def run():
+            if self.pause_gate is not None and self.pause_gate(run):
+                return
             if self.is_live is not None and not self.is_live():
                 return
             try:
@@ -507,6 +646,27 @@ class Cluster:
         self.down: set = set()
         self.incarnations: Dict[int, int] = {}
         self._crash_info: Dict[int, dict] = {}
+        # gray-failure lifecycle: stop-the-world paused node ids, their parked
+        # (popped-but-frozen) tasks that late-fire in order at resume, a
+        # per-node pause generation (a stale resume timer must not end a
+        # NEWER pause), and outbound packets held by a journal-append stall
+        # (fsync-before-reply: a stalled disk mutes the node's sends)
+        self.paused: set = set()
+        self._parked: Dict[int, List[Callable]] = {}
+        self._pause_epochs: Dict[int, int] = {}
+        self._held_sends: Dict[int, List[Callable]] = {}
+        self._stall_epochs: Dict[int, int] = {}
+        # adaptive-timeout + gray-failure knobs (LocalConfig; env-overridable)
+        from ..config import LocalConfig
+        _cfg = node_config if node_config is not None else LocalConfig.from_env()
+        self.reply_backoff_factor = _cfg.reply_backoff_factor
+        self.reply_backoff_max_s = _cfg.reply_backoff_max_s
+        self.reply_backoff_jitter = _cfg.reply_backoff_jitter
+        self.reply_rearm_budget = _cfg.reply_rearm_budget
+        self.slow_peer_params = (_cfg.slow_peer_ewma_alpha,
+                                 _cfg.slow_peer_latency_threshold_s,
+                                 _cfg.slow_peer_penalty_s)
+        self.journal_corruption_policy = _cfg.journal_corruption_policy
         # catch-up ranges a restart has accepted but not yet handed to
         # Bootstrap (the +1us relaunch task): a second crash inside that
         # window must re-inherit them, not forget the data holes
@@ -534,6 +694,9 @@ class Cluster:
         if journal:
             from .journal import Journal
             self.journal = Journal()
+            # append-time clock: the torn-write injector's acked-record
+            # soundness gate needs to know how old the tail append is
+            self.journal.now_us = lambda: self.queue.now_micros
             for node in self.nodes.values():
                 for store in node.command_stores.all_stores():
                     self.journal.attach(store)
@@ -559,8 +722,10 @@ class Cluster:
         if self._delayed_stores:
             exec_rng = self.rng.fork()
             is_live = scheduler.is_live
+            pause_gate = (lambda nid: (lambda task: self._gate(nid, task)))(node_id)
             executor_factory = (lambda rng: (lambda i: DelayedAgentExecutor(
-                self.agent, self.queue, rng.fork(), is_live=is_live)))(exec_rng)
+                self.agent, self.queue, rng.fork(), is_live=is_live,
+                pause_gate=pause_gate)))(exec_rng)
         svc.boot_cap = boot_epoch
         try:
             node = Node(
@@ -577,6 +742,79 @@ class Cluster:
             svc.boot_cap = None
         return node
 
+    # -- pause lifecycle (the pause nemesis substrate) ------------------------
+    def _gate(self, node_id: int, task: Callable[[], None]) -> bool:
+        """Park ``task`` if ``node_id`` is stop-the-world paused.  Returns
+        True when parked (the caller must NOT run); parked tasks late-fire in
+        park order at ``resume``.  Idle-accounting note: a parked task was
+        already popped (counter decremented) and resume re-adds it as a fresh
+        entry (counter incremented) — the queue's live accounting stays exact
+        across the pause, the PR-1 cancel() bug class's pause analog."""
+        if node_id in self.paused:
+            self._parked.setdefault(node_id, []).append(task)
+            return True
+        return False
+
+    def pause(self, node_id: int) -> int:
+        """Stop the node's world: scheduler, sinks, store executors and
+        timers freeze (tasks park as they come due); inbound messages queue.
+        Peers observe only silence — the node is slow, not dead.  Returns a
+        pause generation token for ``resume``."""
+        assert node_id in self.nodes and node_id not in self.down, \
+            f"node {node_id} is not live"
+        assert node_id not in self.paused, f"node {node_id} is already paused"
+        self.paused.add(node_id)
+        epoch = self._pause_epochs.get(node_id, 0) + 1
+        self._pause_epochs[node_id] = epoch
+        self._count("node_pauses")
+        return epoch
+
+    def resume(self, node_id: int, token: Optional[int] = None) -> None:
+        """End a pause: every parked task re-enqueues at NOW, in park order —
+        all frozen timers late-fire, violating every timeout assumption at
+        once (the post-GC-pause timer storm).  ``token`` guards a stale
+        resume timer against ending a newer pause."""
+        if node_id not in self.paused:
+            return
+        if token is not None and self._pause_epochs.get(node_id) != token:
+            return
+        self.paused.discard(node_id)
+        for task in self._parked.pop(node_id, []):
+            self.queue.add_after(0, task)
+        self._count("node_resumes")
+
+    # -- journal-append stalls (the disk-stall nemesis substrate) -------------
+    def hold_send(self, node_id: int, emit: Callable[[], None]) -> None:
+        """Buffer an outbound packet of a journal-stalled node (the send path
+        blocks on fsync).  Drains at ``unstall_journal``; dies with the
+        process at ``crash`` — alongside the unsynced journal tail, so no
+        peer ever observed state the crash un-persisted."""
+        self._held_sends.setdefault(node_id, []).append(emit)
+
+    def stall_journal(self, node_id: int) -> int:
+        """Start a journal-append stall: durability (and every outbound
+        packet — fsync-before-reply) lags execution until unstall.  Returns a
+        stall generation token."""
+        assert self.journal is not None, "disk stalls require the journal"
+        assert node_id in self.nodes and node_id not in self.down, \
+            f"node {node_id} is not live"
+        self.journal.stall(node_id)
+        epoch = self._stall_epochs.get(node_id, 0) + 1
+        self._stall_epochs[node_id] = epoch
+        self._count("journal_stalls")
+        return epoch
+
+    def unstall_journal(self, node_id: int, token: Optional[int] = None) -> None:
+        """The append path caught up: buffered records become durable and the
+        held outbound packets hit the wire (in order)."""
+        if self.journal is None or not self.journal.is_stalled(node_id):
+            return
+        if token is not None and self._stall_epochs.get(node_id) != token:
+            return
+        self.journal.unstall(node_id)
+        for emit in self._held_sends.pop(node_id, []):
+            self.queue.add_after(0, emit)
+
     # -- crash-restart lifecycle (the crash-restart nemesis substrate) --------
     def crash(self, node_id: int) -> None:
         """Kill a node mid-flight: its in-memory command stores, per-key
@@ -592,6 +830,18 @@ class Cluster:
             "assignment is not stable across a restart boundary"
         node = self.nodes.pop(node_id)
         self.down.add(node_id)
+        # a paused process dies parked: its frozen timers/deliveries die with
+        # it (they were already popped, so accounting stays exact)
+        self.paused.discard(node_id)
+        self._parked.pop(node_id, None)
+        # crash during a journal-append stall: the unsynced tail is gone, and
+        # so are the outbound packets fsync was holding — no peer ever saw
+        # the state those records carried
+        self._held_sends.pop(node_id, None)
+        lost = self.journal.lose_unsynced(node_id)
+        if lost:
+            self.stats["journal_unsynced_lost"] = \
+                self.stats.get("journal_unsynced_lost", 0) + lost
         # invalidate every queued delivery/timer addressed to this incarnation
         self.incarnations[node_id] = self.incarnations.get(node_id, 0) + 1
         # durable restart metadata (real nodes persist bootstrap progress
@@ -658,16 +908,59 @@ class Cluster:
                 node.command_stores.update_topology(topo)
         from ..local import commands as C
         from ..local.command_store import CommandStore, SafeCommandStore
+        from ..primitives.keys import Ranges as _Ranges
+        quarantine = _Ranges.EMPTY
         for cs in node.command_stores.all_stores():
             self.journal.attach(cs)
-            rebuilt = self.journal.restart_commands(node_id, cs.id)
+            # verified replay: every record re-checked against its CRC32; a
+            # torn tail truncates to the last whole record; mid-log
+            # corruption halts loudly or quarantines per the configured
+            # policy (LocalConfig.journal_corruption_policy)
+            replay = self.journal.restart_replay(
+                node_id, cs.id, policy=self.journal_corruption_policy)
+            if replay.torn_tail_dropped:
+                self.stats["journal_torn_records"] = \
+                    self.stats.get("journal_torn_records", 0) \
+                    + replay.torn_tail_dropped
+            damaged = dict(replay.quarantined)
+
+            def on_damaged(txn_id, command, problem, cs=cs, damaged=damaged):
+                # a record that PASSED checksum but decoded to inconsistent
+                # state (replay-side damage): quarantine it like a corrupt
+                # record — drop its journal entries, bootstrap its footprint
+                self.journal.erase_key(node_id, cs.id, txn_id)
+                damaged[txn_id] = command.route
+
             # synchronous replay (process start blocks on journal replay),
             # under the store's logical-thread discipline
             prev, CommandStore._current = CommandStore._current, cs
             try:
-                C.replay_journal(SafeCommandStore(cs), rebuilt)
+                safe = SafeCommandStore(cs)
+                C.replay_journal(safe, replay.commands, on_damaged=on_damaged)
+                for txn_id in replay.quarantined:
+                    # knowledge LOST, not absent: the tombstone answers
+                    # "truncated/unknowable" — a quarantined replica that
+                    # answers "never witnessed" hands recovery/inference a
+                    # false proof (an applied txn was invalidated with it)
+                    C.install_quarantine_tombstone(safe, txn_id)
             finally:
                 CommandStore._current = prev
+            if damaged:
+                self.stats["journal_quarantined_txns"] = \
+                    self.stats.get("journal_quarantined_txns", 0) + len(damaged)
+                for txn_id, route in damaged.items():
+                    if route is None:
+                        # no surviving record names a route: route is set at
+                        # the FIRST transition (preaccept), so a route-less
+                        # txn never progressed past a stub — no writes can
+                        # have landed, the tombstone alone suffices.  (A
+                        # whole-store fallback here bootstrapped [k0,k1000)
+                        # mid-churn and recreated the seed-6 refencing stall.)
+                        continue
+                    parts = route.participants()
+                    if not isinstance(parts, _Ranges):
+                        parts = parts.to_ranges()
+                    quarantine = quarantine.union(parts)
             resume = getattr(cs.progress_log, "resume_after_restart", None)
             if resume is not None:
                 resume()
@@ -679,6 +972,11 @@ class Cluster:
                 if n != node_id:
                     node.on_remote_sync_complete(n, epoch)
         pending = info["pending"]
+        if len(quarantine):
+            # quarantined footprints re-enter the bootstrap catch-up ladder:
+            # the replica treats the affected ranges as never-fetched and
+            # streams them fresh from peers (quarantine-and-bootstrap)
+            pending = pending.union(quarantine)
         if pending:
             self._pending_catchup[node_id] = pending
 
@@ -782,6 +1080,9 @@ class Cluster:
                 incarnation is not None
                 and incarnation != self.incarnations.get(to_node, 0)):
             return   # the TCP connection died with the target's process
+        if self._gate(to_node, lambda: self._deliver(
+                to_node, request, from_node, ctx, incarnation)):
+            return   # paused process: the packet queues in its socket buffer
         node = self.nodes.get(to_node)
         if node is None:
             return
@@ -808,6 +1109,8 @@ class Cluster:
         def deliver():
             if to_node in self.down or inc != self.incarnations.get(to_node, 0):
                 return  # the recipient crashed while the reply was in flight
+            if self._gate(to_node, deliver):
+                return  # paused recipient: the reply queues until resume
             if self.tracer is not None:
                 self.tracer("RECV_RPLY", from_node, to_node,
                             reply_context.msg_id, reply, self.queue.now_micros)
@@ -841,6 +1144,8 @@ class Cluster:
         batch: prefetch the batch's declared deps queries per store (one fused
         device launch each), then run the handlers sequentially in arrival
         order — exact sequential semantics, batched device traffic."""
+        if self._gate(to_node, lambda: self._drain_inbox(to_node)):
+            return   # paused process: the batch drains at resume
         box = self._inboxes.get(to_node, [])
         now = self.queue.now_micros
         ready = sorted(e for e in box if e[0] <= now)

@@ -32,8 +32,13 @@ def dump_wait_state(cluster: Cluster) -> str:
     frontier.  Names every blocked txn id and what it waits on."""
     from ..local.status import SaveStatus
     lines: List[str] = []
+    stalled = sorted(n for n in cluster.nodes
+                     if cluster.journal is not None
+                     and cluster.journal.is_stalled(n))
     lines.append(f"sim_time_s={cluster.now_micros / 1e6:.3f} "
                  f"down_nodes={sorted(cluster.down)} "
+                 f"paused_nodes={sorted(cluster.paused)} "
+                 f"stalled_journals={stalled} "
                  f"epoch={cluster.topologies[-1].epoch}")
     for node_id in sorted(cluster.nodes):
         node = cluster.nodes[node_id]

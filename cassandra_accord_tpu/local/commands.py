@@ -637,7 +637,52 @@ _REPLAY_WITNESS = {
 }
 
 
-def replay_journal(safe_store: SafeCommandStore, rebuilt) -> None:
+def _replay_integrity_problem(command: Command) -> Optional[str]:
+    """Structural validation of one journal-rebuilt command BEFORE it touches
+    any index: a record that passed its checksum can still decode to state
+    replay cannot execute (field-level damage, or a harness bug).  Returns a
+    description of the problem, or None when the command is installable.
+    Conservative: only conditions replay itself depends on are checked."""
+    status = command.save_status
+    if not isinstance(status, SaveStatus):
+        return f"save_status decoded to {type(status).__name__}"
+    if status in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
+        # pass 2 re-derives the execution frontier from these
+        if command.execute_at is None:
+            return f"{status.name} without execute_at"
+        if command.partial_deps is None:
+            return f"{status.name} without partial_deps"
+        if command.route is None:
+            return f"{status.name} without route"
+        if command.partial_txn is None and not command.txn_id.kind.awaits_only_deps:
+            return f"{status.name} without partial_txn"
+    elif command.has_been(Status.PRE_COMMITTED) and not status.is_truncated \
+            and status is not SaveStatus.INVALIDATED \
+            and command.execute_at is None:
+        return f"{status.name} without execute_at"
+    return None
+
+
+def install_quarantine_tombstone(safe_store: SafeCommandStore,
+                                 txn_id: TxnId) -> Command:
+    """Replace journal-lost state with an ERASED tombstone (the truncated
+    tier).  The distinction is load-bearing for evidence soundness: an
+    absent command answers recovery/inference with "never witnessed", and a
+    quorum of quarantined replicas then PROVES a false negative — the
+    durability-watermark ``invalid_if_undecided`` inference invalidated an
+    applied-at-UNIVERSAL txn on every replica that asked.  A truncated
+    tombstone instead answers "decided but unknowable": recovery gives up
+    (Lost-class), preaccept refuses resurrection, and the quarantine
+    bootstrap streams the actual outcome's data from peers."""
+    command = Command(txn_id)
+    command.save_status = SaveStatus.ERASED
+    safe_store.store.commands[txn_id] = command
+    safe_store.journal_save(command)
+    return command
+
+
+def replay_journal(safe_store: SafeCommandStore, rebuilt,
+                   on_damaged=None) -> None:
     """Install journal-reconstructed commands into a FRESH store (restart after
     crash).  Volatile state was lost with the process: commands arrive at
     their durable tier (STABLE / PRE_APPLIED at most transient-wise) with no
@@ -652,9 +697,30 @@ def replay_journal(safe_store: SafeCommandStore, rebuilt) -> None:
        unknown locally (their Commit/Apply was in flight to the dead node)
        stay in waiting_on; maybe_execute reports them to the progress log's
        blocked-dependency machinery, which fetches or recovers them — that is
-       how a restarted replica catches up past what its journal predates."""
+       how a restarted replica catches up past what its journal predates.
+
+    Corruption handling: each command is structurally validated BEFORE
+    touching any index.  A damaged one is reported through
+    ``on_damaged(txn_id, command, problem)`` — the restart path quarantines
+    its journal entries and bootstraps its footprint — and replaced by an
+    ERASED tombstone via ``install_quarantine_tombstone``: the replica's
+    knowledge was LOST, not absent, so it must answer "truncated /
+    unknowable", never "never witnessed" (a quarantined replica presenting
+    watermark-based non-witness evidence got an APPLIED txn invalidated
+    cluster-wide).  With no handler the damage halts replay loudly (a
+    silently-installed broken command is how replicas diverge)."""
     store = safe_store.store
+    damaged: set = set()
     for txn_id, command in rebuilt.items():
+        problem = _replay_integrity_problem(command)
+        if problem is not None:
+            check_state(on_damaged is not None,
+                        "journal replay of %s decoded damaged state: %s",
+                        txn_id, problem)
+            damaged.add(txn_id)
+            on_damaged(txn_id, command, problem)
+            install_quarantine_tombstone(safe_store, txn_id)
+            continue
         # NOT_DEFINED records (e.g. an InformOfTxn-created stub) install too —
         # the journal tracks them, so the store must keep tracking them or the
         # end-of-burn persistence check reads the gap as an untracked erasure
@@ -669,6 +735,8 @@ def replay_journal(safe_store: SafeCommandStore, rebuilt) -> None:
             # Writes carries its read footprint (Ranges) in .keys
             _merge_applied_writes(store, command.writes, command.execute_at)
     for command in list(rebuilt.values()):
+        if command.txn_id in damaged:
+            continue
         if command.save_status in (SaveStatus.STABLE, SaveStatus.PRE_APPLIED):
             initialise_waiting_on(safe_store, command)
             maybe_execute(safe_store, command, always_notify_listeners=False)

@@ -65,6 +65,16 @@ class Node(ConfigurationService.Listener):
         if topo is not None and topo.size > 0:
             self.on_topology_update(topo, start_sync=True)
 
+    def slow_peers(self) -> frozenset:
+        """Peers the sink's gray-failure tracker currently marks slow
+        (reply-latency EWMA over threshold, or inside the post-timeout
+        penalty window).  Coordinators route per-shard data reads around
+        them; empty when the sink has no tracker (maelstrom, mocks)."""
+        tracker = getattr(self.message_sink, "slow_replicas", None)
+        if tracker is None:
+            return frozenset()
+        return tracker.slow_peers()
+
     # -- time (Node.java:335-360) -------------------------------------------
     def now_micros(self) -> int:
         return self._now_micros()

@@ -262,10 +262,21 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk):
             command = safe_store.get_if_exists(txn_id)
             if command is None or command.save_status.has_been(Status.PRE_APPLIED):
                 return
-            if merged.execute_at is None:
-                return
             writes_free = not txn_id.is_write   # sync points / reads: applying
-            if writes_free or (merged.writes is not None                # is a no-op
+            if merged.execute_at is None:       # is a no-op
+                # truncated with NO recoverable outcome (an ERASED tombstone —
+                # e.g. every consulted peer quarantined the txn's corrupt
+                # journal records): the cluster applied this write, this
+                # replica never did, and the individual Apply will never
+                # arrive.  The only remedy is the peer-snapshot heal (data
+                # stores are timestamp-ordered and idempotent; at least one
+                # replica past the durable fence holds the full set).
+                # Returning silently left a permanent one-replica data hole
+                # once GC erased the local stub below the shard watermark.
+                if txn_id.is_write and len(local_parts_t):
+                    _heal_store_gaps(node, safe_store, local_parts_t)
+                return
+            if writes_free or (merged.writes is not None
                                and merged.applied_for.contains_all(local_parts_t)):
                 was_waiting = command.waiting_on is not None \
                     and command.waiting_on.is_waiting()

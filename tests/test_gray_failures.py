@@ -1,0 +1,702 @@
+"""Gray-failure nemesis suite: stop-the-world pauses, journal-append stalls,
+journal corruption tolerance, and the adaptive timeout/backoff machinery.
+
+Covers ISSUE 2: pause/resume with late-firing timers (PendingQueue idle
+accounting staying exact — the PR-1 ``cancel()`` bug class, now for parked
+tasks), disk stalls whose mid-stall crash loses the unsynced tail,
+per-record checksums catching every injected bit flip, torn tails
+truncating to the last whole record, the halt-loud vs quarantine-and-
+bootstrap corrupt-record policies, exponential reply-timeout backoff with a
+re-arm budget, slow-replica tracking feeding read speculation, the
+``heal()`` reroll-task cancellation, and the burn CLI ``--json`` summary.
+"""
+import json
+import os
+from dataclasses import replace
+
+import pytest
+
+from cassandra_accord_tpu.config import LocalConfig
+from cassandra_accord_tpu.harness.burn import SimulationException, run_burn
+from cassandra_accord_tpu.harness.chaos import RandomizedLinkConfig
+from cassandra_accord_tpu.harness.cluster import (
+    Cluster, LinkConfig, SlowReplicaTracker, backoff_timeout_us)
+from cassandra_accord_tpu.harness.journal import (
+    Journal, JournalCorruption, Record)
+from cassandra_accord_tpu.harness.watchdog import StallError, dump_wait_state
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.local.status import SaveStatus
+from cassandra_accord_tpu.coordinate.tracking import ReadTracker
+from cassandra_accord_tpu.primitives.keys import IntKey, Range
+from cassandra_accord_tpu.topology.topology import Shard, Topologies, Topology
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v):
+    return IntKey(v)
+
+
+def make_cluster(seed=1, nodes=(1, 2, 3), link=None, progress_poll_s=0.2,
+                 node_config=None, progress_log=True):
+    shards = [Shard(Range(k(0), k(1000)), list(nodes))]
+    return Cluster(Topology(1, shards), seed=seed, link_config=link,
+                   journal=True, progress_log=progress_log,
+                   progress_poll_s=progress_poll_s, node_config=node_config)
+
+
+def _exact_live(queue):
+    return sum(1 for e in queue._heap if not e.cancelled and not e.recurring)
+
+
+def gray_config(**overrides):
+    return replace(LocalConfig(), **overrides)
+
+
+# ---------------------------------------------------------------------------
+# Pause: stop-the-world freeze, late-firing timers, exact idle accounting
+# ---------------------------------------------------------------------------
+
+def test_pause_freezes_timers_and_late_fires_at_resume():
+    """A paused node's due timers park (in order) and fire at resume — not
+    before, not dropped — and the queue's live accounting stays exact."""
+    cluster = make_cluster(seed=1)
+    fired = []
+    cluster.nodes[3].scheduler.once(0.01, lambda: fired.append("a"))
+    cluster.nodes[3].scheduler.once(0.02, lambda: fired.append("b"))
+    cluster.pause(3)
+    cluster.run_for(1.0)
+    assert fired == [], "paused node's timers must not fire"
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+    cluster.resume(3)
+    cluster.run_for(0.1)
+    assert fired == ["a", "b"], "parked timers must late-fire in park order"
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+
+
+def test_cancel_while_parked_does_not_late_fire():
+    """The pause analog of the PR-1 cancel() class: cancelling a timer whose
+    guarded task already parked must prevent the late fire at resume (the
+    queue entry is gone — only the holder flag can honor the cancel)."""
+    cluster = make_cluster(seed=2)
+    fired = []
+    handle = cluster.nodes[3].scheduler.once(0.01, lambda: fired.append(1))
+    cluster.pause(3)
+    cluster.run_for(0.5)      # timer comes due, parks
+    handle.cancel()
+    cluster.resume(3)
+    cluster.run_until_idle()
+    assert fired == []
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+
+
+def test_pause_resume_idle_accounting_stays_exact_across_cycles():
+    """Seeded pause/resume cycles with timers landing before, inside and
+    after each pause window: `_live_nonrecurring` equals the heap's exact
+    live count at every phase boundary."""
+    cluster = make_cluster(seed=3)
+    rng = RandomSource(17)
+    fired = []
+    for cycle in range(12):
+        victim = rng.pick([1, 2, 3])
+        for _ in range(rng.next_int(1, 5)):
+            cluster.nodes[victim].scheduler.once(
+                rng.next_float() * 0.4, lambda: fired.append(1))
+        cluster.pause(victim)
+        cluster.run_for(rng.next_float() * 0.5)
+        assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+        cluster.resume(victim)
+        cluster.run_for(rng.next_float() * 0.2)
+        assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+    cluster.run_until_idle()
+    assert fired
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+
+
+def test_paused_node_is_slow_not_dead():
+    """With one replica paused the quorum still commits; after resume the
+    paused node drains its parked deliveries and converges — no restart, no
+    journal replay, exactly the regime fail-stop nemeses never exercise.
+    (progress_log off: with it, a peer's recovery legitimately preempts the
+    round racing the paused replica's timeout — tested in the burns.)"""
+    cluster = make_cluster(seed=4, progress_log=False)
+    cluster.pause(3)
+    res = cluster.nodes[1].coordinate(list_txn([], {k(5): "while-paused"}))
+    assert cluster.run_until(res.is_done, max_tasks=500_000)
+    assert res.is_success(), res.failure
+    cluster.resume(3)
+    cluster.run_for(30)
+    assert cluster.stores[3].get(k(5)) == ("while-paused",)
+
+
+def test_crash_of_paused_node_drops_parked_tasks():
+    """A paused process can die: its parked (already-popped) tasks die with
+    it without corrupting idle accounting, and restart works normally."""
+    cluster = make_cluster(seed=5)
+    fired = []
+    cluster.nodes[3].scheduler.once(0.01, lambda: fired.append(1))
+    cluster.pause(3)
+    cluster.run_for(0.5)
+    cluster.crash(3)
+    assert 3 not in cluster.paused
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+    cluster.restart(3)
+    cluster.run_until_idle()
+    assert fired == []
+    assert cluster.queue._live_nonrecurring == _exact_live(cluster.queue)
+
+
+# ---------------------------------------------------------------------------
+# Disk stall: durability (and sends) lag execution; crash loses the tail
+# ---------------------------------------------------------------------------
+
+def test_disk_stall_crash_loses_unsynced_tail_then_heals():
+    """Writes land while node 3's journal is stalled (its packets are held —
+    fsync-before-reply); a crash mid-stall loses every unsynced record, and
+    the restarted node catches back up through bootstrap/deps."""
+    cluster = make_cluster(seed=6)
+    res = cluster.nodes[1].coordinate(list_txn([], {k(5): "pre"}))
+    assert cluster.run_until(res.is_done)
+    cluster.run_until_idle()
+    pre_records = cluster.journal._live_count((3, 0))
+    assert pre_records > 0
+    cluster.stall_journal(3)
+    res = cluster.nodes[1].coordinate(list_txn([], {k(5): "mid"}))
+    assert cluster.run_until(res.is_done, max_tasks=500_000)
+    cluster.run_for(5)
+    assert cluster.journal._live_count((3, 0)) > pre_records, \
+        "execution must keep appending records during the stall"
+    cluster.crash(3)
+    assert cluster.stats.get("journal_unsynced_lost", 0) > 0
+    assert cluster.journal._live_count((3, 0)) == pre_records, \
+        "crash mid-stall must rewind the journal to the stall watermark"
+    cluster.restart(3)
+    cluster.run_for(60)
+    assert cluster.stores[3].get(k(5)) == ("pre", "mid")
+
+
+def test_disk_stall_unstall_makes_everything_durable():
+    """Unstall drains the held packets and fsyncs the buffer: a crash AFTER
+    unstall loses nothing."""
+    cluster = make_cluster(seed=7)
+    cluster.stall_journal(3)
+    res = cluster.nodes[1].coordinate(list_txn([], {k(9): "v"}))
+    assert cluster.run_until(res.is_done, max_tasks=500_000)
+    cluster.unstall_journal(3)
+    cluster.run_for(10)
+    records = cluster.journal._live_count((3, 0))
+    cluster.crash(3)
+    assert cluster.stats.get("journal_unsynced_lost", 0) == 0
+    assert cluster.journal._live_count((3, 0)) == records
+    cluster.restart(3)
+    cluster.run_for(30)
+    assert cluster.stores[3].get(k(9)) == ("v",)
+
+
+def test_journal_stall_watermark_unit():
+    """Unit contract: records appended after stall() are exactly what
+    lose_unsynced() drops; pre-stall state survives."""
+    from tests.test_restart import _applied_template, _clone_with_status
+    from types import SimpleNamespace
+    template = _applied_template()
+    journal = Journal()
+    store = SimpleNamespace(node=SimpleNamespace(id=4), id=0)
+    journal.save(store, _clone_with_status(template, SaveStatus.STABLE))
+    journal.stall(4)
+    journal.save(store, _clone_with_status(template, SaveStatus.PRE_APPLIED))
+    journal.save(store, _clone_with_status(template, SaveStatus.APPLIED))
+    assert journal.is_stalled(4)
+    lost = journal.lose_unsynced(4)
+    assert lost == 2
+    assert not journal.is_stalled(4)
+    rebuilt = journal.restart_commands(4, 0)
+    assert rebuilt[template.txn_id].save_status is SaveStatus.STABLE
+
+
+# ---------------------------------------------------------------------------
+# Journal integrity: checksums, torn tails, corruption policy
+# ---------------------------------------------------------------------------
+
+def _three_record_journal(node_id=9):
+    """One txn journaled through three transitions => three records."""
+    from tests.test_restart import _applied_template, _clone_with_status
+    from types import SimpleNamespace
+    template = _applied_template()
+    journal = Journal()
+    store = SimpleNamespace(node=SimpleNamespace(id=node_id), id=0)
+    for status in (SaveStatus.ACCEPTED, SaveStatus.STABLE, SaveStatus.APPLIED):
+        journal.save(store, _clone_with_status(template, status))
+    recs = journal.logs[(node_id, 0)][template.txn_id]
+    assert len(recs) == 3
+    return journal, template.txn_id, recs
+
+
+def test_checksum_catches_every_injected_bit_flip():
+    """Property (seeded sweep): flipping ANY single bit of ANY record is
+    detected at restart replay — a tail flip truncates as a torn write, a
+    mid-log flip quarantines (or halts) — never a silent replay of damaged
+    bytes.  CRC32 detects all single-bit errors, so this must be exhaustive
+    over record choice and dense over bit positions."""
+    rng = RandomSource(23)
+    for case in range(120):
+        journal, txn_id, recs = _three_record_journal()
+        idx = rng.next_int(3)
+        rec = recs[idx]
+        nbits = len(rec.payload) * 8
+        bit = rng.next_int(nbits)
+        payload = bytearray(rec.payload)
+        payload[bit // 8] ^= 1 << (bit % 8)
+        rec.payload = bytes(payload)
+        assert rec.try_diff() is None, \
+            f"case {case}: bit {bit} of record {idx} not detected"
+        replay = journal.restart_replay(9, 0, policy="quarantine")
+        if idx == 2:
+            # tail record: torn-write semantics — truncate, keep the prefix
+            assert replay.torn_tail_dropped == 1
+            assert replay.commands[txn_id].save_status is SaveStatus.STABLE
+        else:
+            assert replay.corrupt_records == 1
+            assert txn_id in replay.quarantined
+            assert txn_id not in replay.commands
+            # quarantine scope: the txn's last-known route survives for the
+            # bootstrap ladder
+            assert replay.quarantined[txn_id] is not None
+
+
+def test_mid_log_corruption_halts_loudly_under_halt_policy():
+    journal, txn_id, recs = _three_record_journal()
+    recs[0].payload = b"\x00" + recs[0].payload[1:]
+    with pytest.raises(JournalCorruption):
+        journal.restart_replay(9, 0, policy="halt")
+    # restart_commands is the halt-policy shorthand
+    journal2, _txn, recs2 = _three_record_journal()
+    recs2[1].payload = recs2[1].payload[:-1] + b"\xff"
+    with pytest.raises(JournalCorruption):
+        journal2.restart_commands(9, 0)
+
+
+def test_torn_tail_truncates_to_last_whole_record():
+    """Property (seeded sweep): truncating the tail record at ANY cut point
+    replays as if the torn append never happened."""
+    rng = RandomSource(31)
+    for _ in range(60):
+        journal, txn_id, recs = _three_record_journal()
+        tail = recs[2]
+        cut = 1 + rng.next_int(len(tail.payload) - 1)
+        tail.payload = tail.payload[:cut]
+        replay = journal.restart_replay(9, 0, policy="halt")
+        assert replay.torn_tail_dropped == 1
+        assert replay.corrupt_records == 0
+        # STABLE is the state the surviving prefix recorded
+        assert replay.commands[txn_id].save_status is SaveStatus.STABLE
+
+
+def test_tear_tail_record_injection_roundtrip():
+    """The nemesis-facing injection helper tears the tail; replay truncates
+    silently (no quarantine, no halt — normal WAL recovery)."""
+    journal, txn_id, recs = _three_record_journal()
+    assert journal.tear_tail_record(9, RandomSource(5)) == 1
+    replay = journal.restart_replay(9, 0, policy="halt")
+    assert replay.torn_tail_dropped == 1
+    assert replay.commands[txn_id].save_status is SaveStatus.STABLE
+
+
+def test_record_roundtrip_intact():
+    rec = Record.encode({"save_status": {"$": "SaveStatus", "v": "STABLE",
+                                         "e": 1}})
+    assert rec.try_diff() == {"save_status": {"$": "SaveStatus",
+                                              "v": "STABLE", "e": 1}}
+
+
+def test_restart_quarantines_corrupt_record_and_converges():
+    """End-to-end quarantine-and-bootstrap: a mid-log record of a crashed
+    node's journal is corrupted; restart (policy=quarantine) drops the
+    damaged txn, re-enters the catch-up ladder over its footprint, and the
+    replica converges with its peers — no silent divergence, no halt."""
+    cfg = gray_config(journal_corruption_policy="quarantine")
+    cluster = make_cluster(seed=8, node_config=cfg)
+    for i, value in enumerate(("a", "b", "c")):
+        res = cluster.nodes[1].coordinate(list_txn([], {k(5): value}))
+        assert cluster.run_until(res.is_done, max_tasks=500_000)
+        assert res.is_success(), res.failure
+    cluster.run_until_idle()
+    cluster.crash(3)
+    # corrupt a NON-tail record of some multi-record txn on node 3
+    key = (3, 0)
+    tail_txn = cluster.journal._tail_txn(key)
+    target = None
+    for txn_id, recs in cluster.journal.logs[key].items():
+        if len(recs) >= 2 and txn_id != tail_txn:
+            target = (txn_id, recs[0])
+            break
+    assert target is not None, "fixture needs a multi-record non-tail txn"
+    txn_id, rec = target
+    rec.payload = bytes([rec.payload[0] ^ 0x40]) + rec.payload[1:]
+    cluster.restart(3)
+    assert cluster.stats.get("journal_quarantined_txns", 0) >= 1
+    cluster.run_for(90)
+    datas = {n: cluster.stores[n].get(k(5)) for n in cluster.nodes}
+    assert datas[3] == datas[1] == datas[2], f"divergent: {datas}"
+    assert datas[1] == ("a", "b", "c")
+
+
+def test_restart_halts_loudly_on_corrupt_record_under_halt_policy():
+    cfg = gray_config(journal_corruption_policy="halt")
+    cluster = make_cluster(seed=9, node_config=cfg)
+    res = cluster.nodes[1].coordinate(list_txn([], {k(5): "x"}))
+    assert cluster.run_until(res.is_done)
+    cluster.run_until_idle()
+    cluster.crash(3)
+    key = (3, 0)
+    tail_txn = cluster.journal._tail_txn(key)
+    for txn_id, recs in cluster.journal.logs[key].items():
+        if len(recs) >= 2 and txn_id != tail_txn:
+            recs[0].payload = b"\x01" + recs[0].payload[1:]
+            break
+    else:
+        pytest.skip("no multi-record non-tail txn in fixture")
+    with pytest.raises(JournalCorruption):
+        cluster.restart(3)
+
+
+# ---------------------------------------------------------------------------
+# Adaptive timeout/backoff + slow-replica tracking
+# ---------------------------------------------------------------------------
+
+def test_backoff_timeout_grows_capped_and_deterministic():
+    base, factor, cap, jitter = 2.0, 2.0, 30.0, 0.25
+    prev = 0
+    for attempt in range(8):
+        t = backoff_timeout_us(base, attempt, factor, cap, jitter, salt=42)
+        # deterministic: same (salt, attempt) => same value
+        assert t == backoff_timeout_us(base, attempt, factor, cap, jitter, 42)
+        nominal = min(base * factor ** attempt, cap) * 1e6
+        assert nominal <= t < nominal * (1 + jitter)
+        assert t > prev or nominal == cap * 1e6
+        prev = t
+    # different salts de-phase (golden-ratio hash)
+    assert backoff_timeout_us(base, 1, factor, cap, jitter, 1) \
+        != backoff_timeout_us(base, 1, factor, cap, jitter, 2)
+
+
+def test_reply_rearm_budget_bounds_patience():
+    """Non-final replies re-arm the timeout with exponential backoff up to
+    the budget; past it the LAST armed timer stands, so a lost final reply
+    still fails the callback — bounded patience, never a hang."""
+    from cassandra_accord_tpu.messages.base import Callback, Reply, Request
+
+    class _NonFinal(Reply):
+        is_final = False
+
+    class _Probe(Request):
+        def process(self, node, from_node, reply_context):
+            pass
+
+    cfg = gray_config(reply_rearm_budget=3)
+    cluster = make_cluster(seed=10, node_config=cfg)
+    cluster.request_filter = lambda *a: True   # swallow delivery entirely
+    failures = []
+
+    class _CB(Callback):
+        def on_success(self, from_node, reply):
+            pass
+
+        def on_failure(self, from_node, failure):
+            failures.append(failure)
+
+        def on_callback_failure(self, from_node, failure):
+            raise failure
+
+    sink = cluster.sinks[1]
+    sink.send_with_callback(2, _Probe(), _CB())
+    (msg_id, entry), = sink.callbacks.items()
+    assert entry[3] == 0
+    # feed non-final replies: attempts advance only to the budget
+    for expect in (1, 2, 2, 2):
+        sink.deliver_reply(2, msg_id, _NonFinal())
+        assert sink.callbacks[msg_id][3] == expect
+    # the standing timer eventually fires the failure path
+    cluster.run_until(lambda: bool(failures), max_tasks=100_000)
+    assert failures and msg_id not in sink.callbacks
+    # ... and the timeout marked the peer slow for the penalty window
+    assert 2 in cluster.sinks[1].slow_replicas.slow_peers()
+
+
+def test_slow_replica_tracker_marks_and_recovers():
+    cluster = make_cluster(seed=11)
+    tracker = SlowReplicaTracker(cluster, alpha=0.5, threshold_s=1.0,
+                                 penalty_s=5.0)
+    # fast replies: not slow
+    tracker.record_reply(2, 10_000)
+    assert not tracker.is_slow(2)
+    # latency EWMA crossing the threshold marks slow
+    for _ in range(6):
+        tracker.record_reply(2, 3_000_000)
+    assert tracker.is_slow(2)
+    # recovery: fast replies decay the EWMA back under the threshold
+    for _ in range(12):
+        tracker.record_reply(2, 5_000)
+    assert not tracker.is_slow(2)
+    # a timeout penalizes for the window, then expires with sim time
+    tracker.record_timeout(3)
+    assert tracker.is_slow(3)
+    cluster.queue.now_micros += 6_000_000
+    assert not tracker.is_slow(3)
+
+
+def test_read_tracker_routes_around_slow_replicas():
+    shards = [Shard(Range(k(0), k(500)), [1, 2, 3]),
+              Shard(Range(k(500), k(1000)), [3, 4, 5])]
+    topo = Topologies([Topology(1, shards)])
+    # initial picks avoid slow nodes when an alternative exists
+    t = ReadTracker(topo)
+    picks = t.initial_contacts(prefer=1, avoid=frozenset([1, 3]))
+    assert 1 not in picks and 3 not in picks
+    # all-slow shard: the base pick stands (avoidance must not starve)
+    t2 = ReadTracker(topo)
+    picks2 = t2.initial_contacts(prefer=1, avoid=frozenset([1, 2, 3, 4, 5]))
+    assert picks2, "every shard still gets a read"
+    # speculation prefers the non-slow untried candidate
+    t3 = ReadTracker(topo)
+    t3.initial_contacts(prefer=1)
+    extra = t3.speculate(avoid=frozenset([2, 4]))
+    assert extra and all(n not in (2, 4) for n in extra)
+
+
+def test_paused_coordinator_timeout_late_fires_after_resume():
+    """A paused node's own reply-timeout timers freeze with it: no spurious
+    failure fires mid-pause; at resume the parked timeout runs and the
+    failure path proceeds (gray failure seen from the INSIDE)."""
+    from cassandra_accord_tpu.messages.base import Callback, Request
+
+    class _Probe(Request):
+        def process(self, node, from_node, reply_context):
+            pass
+
+    cluster = make_cluster(seed=12)
+    cluster.request_filter = lambda *a: True
+    failures = []
+
+    class _CB(Callback):
+        def on_success(self, from_node, reply):
+            pass
+
+        def on_failure(self, from_node, failure):
+            failures.append(failure)
+
+        def on_callback_failure(self, from_node, failure):
+            raise failure
+
+    cluster.sinks[1].send_with_callback(2, _Probe(), _CB())
+    cluster.pause(1)
+    cluster.run_for(10)      # way past the 2s base timeout
+    assert failures == [], "a frozen process cannot observe its own timeout"
+    cluster.resume(1)
+    cluster.run_for(1)
+    assert len(failures) == 1, "the parked timeout must late-fire at resume"
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: heal() cancels the chaos reroll task
+# ---------------------------------------------------------------------------
+
+def test_heal_cancels_chaos_reroll_task():
+    link = RandomizedLinkConfig(RandomSource(3), rf=3, interval_s=0.5)
+    cluster = make_cluster(seed=13, link=link)
+    rolls = []
+    orig = link.randomize
+    link.randomize = lambda: (rolls.append(1), orig())[-1]
+    cluster.run_for(2.0)
+    assert rolls, "reroll cadence never fired"
+    assert link._task is not None
+    link.heal()
+    count = len(rolls)
+    cluster.run_for(5.0)
+    assert len(rolls) == count, \
+        "heal() must CANCEL the reroll task, not rely on the no-op guard"
+    assert link._task is None
+
+
+# ---------------------------------------------------------------------------
+# Gray-failure burns (tier-1 smokes + determinism)
+# ---------------------------------------------------------------------------
+
+def _gray_cfg():
+    # aggressive but STAGGERED cadences: the muted-quorum floor lets only
+    # one node be down/paused/stalled at a time on a 3-replica cluster, so
+    # the three axes must time-share the mute slot; short fault durations
+    # keep it cycling
+    return gray_config(
+        restart_interval_s=0.5, restart_downtime_min_s=0.15,
+        restart_downtime_max_s=0.4,
+        pause_interval_s=0.35, pause_min_s=0.1, pause_max_s=0.35,
+        disk_stall_interval_s=0.25, disk_stall_min_s=0.1, disk_stall_max_s=0.3)
+
+
+def test_gray_failure_smoke_burn():
+    """Fast tier-1 smoke: pause + disk-stall + crash-restart (with journal
+    damage injection) all active on one burn; every op resolves, every fault
+    class actually fired, final states agree."""
+    result = run_burn(3, ops=60, concurrency=10, journal=True,
+                      restart_nodes=True, pause_nodes=True, disk_stall=True,
+                      node_config=_gray_cfg(), max_tasks=20_000_000)
+    assert result.resolved == 60
+    assert result.ops_failed == 0
+    assert result.restarts >= 1, f"no crash-restart cycle: {result!r}"
+    assert result.pauses >= 1, f"no pause cycle: {result!r}"
+    assert result.disk_stalls >= 1, f"no disk stall: {result!r}"
+
+
+def test_gray_failure_burn_is_deterministic():
+    kw = dict(ops=50, concurrency=10, journal=True, restart_nodes=True,
+              pause_nodes=True, disk_stall=True, node_config=_gray_cfg(),
+              max_tasks=20_000_000)
+    a = run_burn(5, **kw)
+    b = run_burn(5, **kw)
+    assert (a.ops_ok, a.ops_recovered, a.ops_nacked, a.ops_lost, a.crashes,
+            a.restarts, a.pauses, a.disk_stalls, a.sim_micros) \
+        == (b.ops_ok, b.ops_recovered, b.ops_nacked, b.ops_lost, b.crashes,
+            b.restarts, b.pauses, b.disk_stalls, b.sim_micros)
+
+
+def test_gray_failure_chaos_burn():
+    """One hostile-network seed with all gray-failure axes in tier-1 (the
+    full matrix is gated behind ACCORD_LONG_BURNS)."""
+    cfg = gray_config(
+        restart_interval_s=3.0, restart_downtime_min_s=1.0,
+        restart_downtime_max_s=3.0, pause_interval_s=2.5,
+        disk_stall_interval_s=3.5)
+    result = run_burn(2, ops=60, concurrency=10, chaos=True,
+                      allow_failures=True, durability=True, journal=True,
+                      restart_nodes=True, pause_nodes=True, disk_stall=True,
+                      node_config=cfg, max_tasks=40_000_000)
+    assert result.resolved == 60
+    assert result.pauses >= 1
+
+
+def test_watchdog_dump_reports_gray_state():
+    cluster = make_cluster(seed=14)
+    cluster.pause(2)
+    cluster.stall_journal(3)
+    dump = dump_wait_state(cluster)
+    assert "paused_nodes=[2]" in dump
+    assert "stalled_journals=[3]" in dump
+    cluster.resume(2)
+    cluster.unstall_journal(3)
+
+
+# ---------------------------------------------------------------------------
+# Satellite 5: burn CLI --json summary
+# ---------------------------------------------------------------------------
+
+def test_burn_cli_json_summary(monkeypatch, tmp_path):
+    from cassandra_accord_tpu.harness import burn as burn_mod
+
+    class _FakeResult:
+        seed = 0
+        ops_ok = 4
+        ops_recovered = 1
+        ops_nacked = 0
+        ops_lost = 0
+        ops_failed = 0
+        resolved = 5
+        sim_micros = 1_234_000
+        stats = {"node_crashes": 2, "node_restarts": 2, "node_pauses": 3,
+                 "journal_stalls": 1, "journal_injected_tears": 1}
+
+        def __repr__(self):
+            return "BurnResult(fake)"
+
+    monkeypatch.setattr(burn_mod, "run_burn",
+                        lambda seed, **kw: _FakeResult())
+    path = tmp_path / "summary.json"
+    burn_mod.main(["--seeds", "0", "--ops", "5", "--json", str(path)])
+    doc = json.loads(path.read_text())
+    (entry,) = doc["results"]
+    assert entry["status"] == "pass"
+    assert entry["resolved"] == 5 and entry["recovered"] == 1
+    assert entry["faults"] == {"node_crashes": 2, "node_restarts": 2,
+                               "node_pauses": 3, "journal_stalls": 1,
+                               "journal_injected_tears": 1}
+    assert "wall_s" in entry and entry["sim_ms"] == 1234
+
+
+def test_burn_cli_json_records_stall(monkeypatch, tmp_path):
+    from cassandra_accord_tpu.harness import burn as burn_mod
+
+    def fake_run_burn(seed, **kw):
+        raise SimulationException(seed, StallError("no progress for 120.0s",
+                                                   "BLOCKED [1,42,1]Wk"))
+    monkeypatch.setattr(burn_mod, "run_burn", fake_run_burn)
+    path = tmp_path / "summary.json"
+    with pytest.raises(SystemExit) as exc:
+        burn_mod.main(["--seeds", "7", "--ops", "5", "--json", str(path)])
+    assert exc.value.code == 2
+    doc = json.loads(path.read_text())
+    (entry,) = doc["results"]
+    assert entry["seed"] == 7 and entry["status"] == "stall"
+    assert "no progress" in entry["error"]
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: the open seed-6 range-read stall, as a gated xfail repro
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="open KNOWN_ISSUES repro; run with ACCORD_LONG_BURNS=1")
+@pytest.mark.xfail(strict=False,
+                   reason="KNOWN_ISSUES: seed-6 range-read vs bootstrap-"
+                          "refencing stall — every wait chain roots on a "
+                          "range read that never assembles partial-read "
+                          "coverage while the truncation/staleness ladder "
+                          "re-fences the ranges (burn CLI repro: --seeds 6 "
+                          "--ops 200 --no-restart, watchdog exit 2); open "
+                          "for the Cleanup-lattice investigation")
+def test_seed6_range_read_stall_repro():
+    cfg = LocalConfig.from_env()
+    rf = 2 + RandomSource(6).next_int(8)
+    run_burn(6, ops=200, concurrency=20, rf=rf, chaos=True,
+             allow_failures=True, topology_churn=True, durability=True,
+             journal=True, delayed_stores=True, clock_drift=True,
+             cache_miss=True, restart_nodes=False, node_config=cfg,
+             stall_watchdog_s=cfg.stall_watchdog_after_s,
+             max_tasks=200_000_000)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: the gray-failure x hostile matrix (gated)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="seed-range gray-failure matrix; run with ACCORD_LONG_BURNS=1")
+def test_gray_failure_hostile_matrix_seed_range():
+    """ISSUE 2 acceptance: seeds 0-9 except 6 x 250 ops with pause +
+    disk-stall + crash-restart (journal damage injection active, quarantine
+    policy) alongside the full hostile matrix — all resolve, final states
+    reconcile, zero silent replica divergence.
+
+    Default cadences (restart 20s / pause 15s / disk-stall 17s): the three
+    independent axes COMBINE into roughly the fault rate PR-1's single-axis
+    5s matrix injected.  Tripling all three (restart at 5s with pause+stall
+    active) outruns the bootstrap heal rate and reproduces the open seed-6
+    refencing-stall class at other seeds — overload, not a protocol bug."""
+    cfg = gray_config()
+    fault_totals = {"restarts": 0, "pauses": 0, "stalls": 0}
+    for seed in (0, 1, 2, 3, 4, 5, 7, 8, 9):
+        rf = 2 + RandomSource(seed).next_int(8)
+        result = run_burn(seed, ops=250, concurrency=20, rf=rf, chaos=True,
+                          allow_failures=True, topology_churn=True,
+                          durability=True, journal=True, delayed_stores=True,
+                          clock_drift=True, cache_miss=True,
+                          restart_nodes=True, pause_nodes=True,
+                          disk_stall=True, node_config=cfg,
+                          stall_watchdog_s=300.0, max_tasks=200_000_000)
+        assert result.resolved == 250, result
+        fault_totals["restarts"] += result.restarts
+        fault_totals["pauses"] += result.pauses
+        fault_totals["stalls"] += result.disk_stalls
+    # every axis must actually engage across the range (the aggressive
+    # per-axis cadences are exercised by the tier-1 smokes; here the point
+    # is convergence with all axes live at the sustainable combined rate —
+    # measured 2026-08-02: 3 restarts / 8 pauses / 7 stalls over the range)
+    for axis, total in fault_totals.items():
+        assert total >= 1, (axis, fault_totals)

@@ -50,7 +50,8 @@ def test_journal_diffs_are_incremental():
     store = cluster.nodes[1].command_stores.all_stores()[0]
     logs = cluster.journal.logs[(1, store.id)]
     some_txn = next(iter(logs))
-    diffs = logs[some_txn]
+    # records store the diff's canonical JSON + CRC32; decode verifies both
+    diffs = [record.diff() for record in logs[some_txn]]
     assert len(diffs) >= 2            # several transitions recorded
     # later diffs must be partial (only changed fields), not full snapshots
     assert any(len(d) < len(diffs[0]) for d in diffs[1:]), diffs
