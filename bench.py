@@ -191,18 +191,19 @@ def bench_kernel(t, k=512, b=256, iters=20, keys_per_txn=2, packed=False):
             native.consult_batch(h, qcols, before, qkind, INVALIDATED)
         native_qps = 3 * b / (time.perf_counter() - t0)
     py_qps = host_python_scalar(key_inc, lanes, active, q, before)
-    matmul_flops = 2.0 * b * k * t
-    tflops = dev_qps / b * matmul_flops / 1e12
-    return {"T": t, "K": k, "B": b, "keys_per_txn": keys_per_txn,
-            "packed_result": packed,
-            "index_bytes_int8": 2 * t * k,
-            "device_queries_per_sec": round(dev_qps, 1),
-            "host_numpy_queries_per_sec": round(np_qps, 1),
-            "host_native_queries_per_sec":
-                round(native_qps, 1) if native_qps else None,
-            "host_python_scalar_queries_per_sec": round(py_qps, 1),
-            "device_vs_host_numpy": round(dev_qps / np_qps, 2),
-            "device_join_tflops": round(tflops, 4)}
+    # roofline block (index bytes, join TFLOP/s, MFU) from the unified
+    # device-metrics source — same formulas the flight recorder reports
+    from cassandra_accord_tpu.observe.device import kernel_consult_metrics
+    out = {"T": t, "K": k, "B": b, "keys_per_txn": keys_per_txn,
+           "packed_result": packed,
+           "device_queries_per_sec": round(dev_qps, 1),
+           "host_numpy_queries_per_sec": round(np_qps, 1),
+           "host_native_queries_per_sec":
+               round(native_qps, 1) if native_qps else None,
+           "host_python_scalar_queries_per_sec": round(py_qps, 1),
+           "device_vs_host_numpy": round(dev_qps / np_qps, 2)}
+    out.update(kernel_consult_metrics(t, k, b, dev_qps))
+    return out
 
 
 def bench_graph(t=8192, iters=3):
@@ -468,17 +469,13 @@ def main():
                 _finalize_headline()   # refresh headline after every stage
 
     def kernels():
-        out = [bench_kernel(4096), bench_kernel(65536),
-               bench_kernel(65536, packed=True),
-               # BASELINE config 4: range txns, 1k keys/txn wide join
-               bench_kernel(65536, k=2048, b=64, keys_per_txn=1024,
-                            packed=True)]
-        # MFU for the consult kernel: achieved matmul FLOP/s over the chip's
-        # peak (bf16 ~275 TFLOP/s less one v5p-class chip; report both)
-        for k in out:
-            k["consult_mfu_vs_275tflops"] = round(
-                k["device_join_tflops"] / 275.0, 5)
-        return out
+        # each entry carries the roofline block (join TFLOP/s, MFU vs the
+        # chip's bf16 peak) from observe.device.kernel_consult_metrics
+        return [bench_kernel(4096), bench_kernel(65536),
+                bench_kernel(65536, packed=True),
+                # BASELINE config 4: range txns, 1k keys/txn wide join
+                bench_kernel(65536, k=2048, b=64, keys_per_txn=1024,
+                             packed=True)]
 
     if device and probe_device(timeout_s=60):
         k = stage("kernel_scaling", kernels)

@@ -121,7 +121,11 @@ class _CoordinateTransaction:
         for ok in oks.values():
             execute_at = ok.witnessed_at if execute_at is None else execute_at.merge_max(ok.witnessed_at)
 
+        observer = getattr(self.node, "observer", None)
         if tracker.has_fast_path_accepted():
+            if observer is not None:
+                observer.on_path(self.txn_id, ExecutePath.FAST,
+                                 tracker.fast_path_votes())
             # merge deps only from replicas that voted fast-path (they witnessed
             # everything that could execute before us) — CoordinateTransaction:71-77
             deps = Deps.merge([ok.deps for ok in oks.values() if ok.witnessed_fast_path])
@@ -129,6 +133,9 @@ class _CoordinateTransaction:
         elif execute_at is not None and execute_at.is_rejected:
             self.result.set_failure(Invalidated(self.txn_id, "preaccept rejected"))
         else:
+            if observer is not None:
+                observer.on_path(self.txn_id, ExecutePath.SLOW,
+                                 tracker.fast_path_votes())
             deps = Deps.merge([ok.deps for ok in oks.values()])
             self.extend_to_epoch(execute_at,
                                  lambda: self.propose(Ballot.ZERO, execute_at, deps))

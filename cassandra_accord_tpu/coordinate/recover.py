@@ -54,6 +54,11 @@ def recover(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
     the txn's Result on success, or Invalidated/Preempted/Exhausted."""
     if ballot is None:
         ballot = node.ballot_after(None)
+    observer = getattr(node, "observer", None)
+    if observer is not None:
+        # recovery attribution: the txn's span records who tried to recover
+        # it and how often (the flight recorder's recovery.* counters)
+        observer.on_recovery(node.id, txn_id, ballot)
     _Recover(node, ballot, txn_id, txn, route, result).start()
 
 
@@ -424,6 +429,10 @@ def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,
     the txn turns out to be committed."""
     if ballot is None:
         ballot = node.ballot_after(None)
+    observer = getattr(node, "observer", None)
+    if observer is not None:
+        # invalidation attribution for the txn's flight-recorder span
+        observer.on_invalidate(node.id, txn_id)
     topologies = node.topology.precise_epochs(route, txn_id.epoch, txn_id.epoch)
     topology = node.topology.topology_for_epoch(txn_id.epoch)
     shard = topology.for_key_required(route.home_key)

@@ -155,6 +155,15 @@ class FastPathTracker(AbstractTracker):
     def has_fast_path_accepted(self) -> bool:
         return self._all_success(FastPathShardTracker.has_met_fast_path_criteria)
 
+    def fast_path_votes(self) -> Tuple[int, int]:
+        """(accepts, rejects) electorate vote totals across every shard — the
+        observability accessor behind the flight recorder's
+        ``txn.fastpath.votes_*`` counters (why a txn went slow-path is the
+        first question a latency investigation asks)."""
+        accepts = sum(len(t.fast_path_accepts) for t in self.trackers)
+        rejects = sum(len(t.fast_path_rejects) for t in self.trackers)
+        return accepts, rejects
+
 
 class ReadShardTracker(ShardTracker):
     __slots__ = ("data_received", "in_flight_reads")

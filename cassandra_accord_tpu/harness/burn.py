@@ -139,7 +139,10 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              stall_watchdog_s: Optional[float] = None,
              node_config=None,
              max_tasks: int = 20_000_000,
-             tracer=None, on_submit=None, consult_recorder=None) -> BurnResult:
+             tracer=None, on_submit=None, consult_recorder=None,
+             observer=None,
+             progress_every_s: Optional[float] = None,
+             progress_label: str = "") -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation.
 
     ``chaos=True`` turns on the hostile network (randomized drops, failures,
@@ -161,6 +164,17 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
 
     ``stall_watchdog_s``: raise StallError with a full wait-graph dump after
     this much sim-time without a resolved op (None disables).
+
+    ``observer``: an ``observe.FlightRecorder`` — records the metrics
+    registry, per-txn lifecycle spans (submit/resolve, fast/slow path,
+    recovery attribution, per-node status timelines) and message events for
+    Chrome-trace export.  ZERO observer effect: a same-seed run with and
+    without one yields byte-identical message traces (proven by
+    tests/test_observe.py).
+
+    ``progress_every_s``: heartbeat — print one progress line (ops resolved,
+    in-flight, fast-path share) per this many SIM-seconds, so long seed
+    sweeps aren't silent until the watchdog fires.
     """
     from ..config import LocalConfig
     rng = RandomSource(seed)
@@ -201,7 +215,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                       resolver=resolver, progress_log=progress_log,
                       progress_poll_s=progress_poll_s,
                       batch_window_us=batch_window_us,
-                      node_config=node_config)
+                      node_config=node_config,
+                      observer=observer)
     cluster.tracer = tracer
     if consult_recorder is not None:
         # trace-driven data-plane bench (harness/consult_trace.py): wrap every
@@ -355,6 +370,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         obs = rec["obs"]
         state["in_flight"] -= 1
         now = cluster.now_micros
+        if observer is not None:
+            observer.on_resolve(rec["txn_id"], kind, now)
         if kind == "ok":
             obs.complete(now, reads or {}, writes or {})
             result.ops_ok += 1
@@ -476,6 +493,9 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                    "writes": dict(writes), "coordinator": coordinator.id,
                    "settled": False}
             inflight[op_id] = rec
+            if observer is not None:
+                observer.on_submit(op_id, txn_id, coordinator.id,
+                                   cluster.now_micros)
             if on_submit is not None:
                 on_submit(op_id, txn_id, txn, coordinator.id)
 
@@ -547,6 +567,26 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                                  stalled_after_s=stall_watchdog_s,
                                  interval_s=cfg.stall_watchdog_interval_s)
         watchdog.attach()
+    heartbeat_task = None
+    if progress_every_s:
+        # one line per N sim-seconds so long seed sweeps aren't silent until
+        # the watchdog fires.  NOTE: unlike the flight recorder this DOES
+        # schedule (a recurring sim task) — it shifts queue sequence numbers,
+        # so runs meant for trace reconciliation should leave it off.
+        label = progress_label if progress_label else f"seed {seed}"
+
+        def heartbeat():
+            line = (f"[burn {label}] sim={cluster.now_micros / 1e6:.1f}s "
+                    f"resolved={result.resolved}/{ops} "
+                    f"in_flight={state['in_flight']}")
+            if observer is not None:
+                fast = observer.registry.counter("txn.path.fast").value
+                slow = observer.registry.counter("txn.path.slow").value
+                if fast + slow:
+                    line += f" fast_path={100.0 * fast / (fast + slow):.0f}%"
+            print(line, flush=True)
+        heartbeat_task = cluster.scheduler.recurring(float(progress_every_s),
+                                                     heartbeat)
     submit_next()
 
     try:
@@ -555,6 +595,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         # settle (the reference's noMoreWorkSignal, Cluster.java:470-475)
         if watchdog is not None:
             watchdog.cancel()   # resolved stops moving by design from here on
+        if heartbeat_task is not None:
+            heartbeat_task.cancel()
         if churn_task is not None:
             churn_task.cancel()
         if pause_nemesis is not None:
@@ -626,18 +668,17 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             result.stats["cache_miss_loads"] = sum(
                 cs.cache_miss_loads for node in cluster.nodes.values()
                 for cs in node.command_stores.all_stores())
-        # data-plane telemetry (tpu/verify resolvers): batching + tier choices
-        tel = {"prefetch_hits": 0, "prefetch_patched": 0, "prefetch_misses": 0,
-               "walk_consults": 0, "host_consults": 0, "native_consults": 0,
-               "device_consults": 0}
-        for node in cluster.nodes.values():
-            for store in node.command_stores.all_stores():
-                r = getattr(store.resolver, "tpu", store.resolver)
-                if hasattr(r, "prefetch_hits"):
-                    for k2 in tel:
-                        tel[k2] += getattr(r, k2)
+        # data-plane telemetry (tpu/verify resolvers): batching + tier
+        # choices, from the unified device-metrics source (observe.device —
+        # the same counters the flight recorder and bench.py report)
+        from ..observe.device import cluster_resolver_totals
+        tel = cluster_resolver_totals(cluster)
         if any(tel.values()):
             result.stats.update({f"resolver_{k2}": v for k2, v in tel.items()})
+        if observer is not None:
+            # end-of-run pull collection: simulator stats, per-store gauges,
+            # resolver counters — one registry for burns AND bench reporting
+            observer.collect_cluster(cluster)
         if result.resolved < ops:
             raise HistoryViolation(
                 f"only {result.resolved}/{ops} ops resolved (liveness stall): "
@@ -676,6 +717,14 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 for store in node.command_stores.all_stores():
                     cluster.journal.verify_against(store)
     except BaseException as e:  # noqa: BLE001
+        if observer is not None:
+            # the recording is most valuable on a FAILED seed: pull-collect
+            # the cluster gauges so the artifacts written by the CLI's
+            # failure path carry the final simulator/store state too
+            try:
+                observer.collect_cluster(cluster)
+            except Exception:  # noqa: BLE001 — never mask the real failure
+                pass
         raise SimulationException(seed, e) from e
     return result
 
@@ -759,6 +808,17 @@ def main(argv=None) -> None:
                         "faults injected) after every seed — seed-range "
                         "matrix runs diff across PRs instead of eyeballing "
                         "logs")
+    p.add_argument("--metrics-out", default=None, metavar="PATH",
+                   help="write the flight recorder's metrics-registry "
+                        "snapshot (stable JSON; per-seed suffix on seed "
+                        "ranges) after every seed")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="write the flight recorder's Chrome trace-event "
+                        "JSON (open in Perfetto / chrome://tracing; one "
+                        "track per node/store) after every seed")
+    p.add_argument("--progress", type=float, default=None, metavar="SIM_S",
+                   help="heartbeat: one progress line (resolved, in-flight, "
+                        "fast-path %%) per SIM_S sim-seconds")
     p.add_argument("--no-watchdog", action="store_true",
                    help="disable the stall watchdog (on stall it dumps the "
                         "wait graph + status frontier and exits nonzero)")
@@ -786,6 +846,21 @@ def main(argv=None) -> None:
     lo, _, hi = args.seeds.partition(":")
     seeds = range(int(lo), int(hi) + 1) if hi else [int(lo)]
     summaries: list = []
+
+    def artifact_path(path: str, seed: int) -> str:
+        """Per-seed artifact name on seed ranges; the exact path otherwise."""
+        if len(seeds) == 1:
+            return path
+        import os.path as _p
+        stem, ext = _p.splitext(path)
+        return f"{stem}.seed{seed}{ext or '.json'}"
+
+    if args.reconcile and (args.metrics_out or args.trace_out):
+        # reconcile runs two bare runs per seed and diffs them; a flight
+        # recorder would conflate both into one recording — say so up front
+        # instead of silently never writing the files
+        print("warning: --metrics-out/--trace-out are ignored with "
+              "--reconcile (no artifacts will be written)", flush=True)
 
     def write_json() -> None:
         if args.json is None:
@@ -817,6 +892,29 @@ def main(argv=None) -> None:
                   stall_watchdog_s=watchdog_s,
                   node_config=cfg,
                   max_tasks=200_000_000)
+        observer = None
+        if (args.metrics_out or args.trace_out) and not args.reconcile:
+            # flight recorder (reconcile runs its own two bare runs: the
+            # recorder would conflate them, so it stays off there — warned
+            # once before the loop)
+            from ..observe import FlightRecorder
+            observer = FlightRecorder(record_messages=bool(args.trace_out))
+            kw["observer"] = observer
+        if args.progress:
+            kw.update(progress_every_s=args.progress,
+                      progress_label=f"seed {seed}")
+
+        def write_artifacts(observer=observer, seed=seed):
+            if observer is None:
+                return
+            import json as _json
+            if args.metrics_out:
+                with open(artifact_path(args.metrics_out, seed), "w") as f:
+                    _json.dump(observer.metrics_snapshot(), f, indent=2,
+                               sort_keys=True)
+                    f.write("\n")
+            if args.trace_out:
+                observer.write_trace(artifact_path(args.trace_out, seed))
         t0 = _time.perf_counter()
         entry = {"seed": seed, "rf": rf, "ops": args.ops}
         summaries.append(entry)
@@ -838,6 +936,12 @@ def main(argv=None) -> None:
                     sim_ms=result.sim_micros // 1000,
                     faults={k: result.stats[k] for k in _FAULT_KEYS
                             if result.stats.get(k)})
+                if observer is not None:
+                    # --json enrichment: the cluster-scope registry (outcome
+                    # partition, path split, recovery/timeout counters)
+                    entry["metrics"] = \
+                        observer.metrics_snapshot().get("cluster", {})
+                write_artifacts()
                 write_json()
                 print(f"seed {seed}: {result!r} (rf={rf}, "
                       f"{_time.perf_counter() - t0:.1f}s)")
@@ -852,6 +956,9 @@ def main(argv=None) -> None:
             entry.update(status=status,
                          wall_s=round(_time.perf_counter() - t0, 3),
                          error=str(e.cause)[:2000])
+            # the flight recording is MOST valuable on a failed seed: write
+            # whatever was captured up to the failure point
+            write_artifacts()
             write_json()
             if isinstance(e.cause, StallError):
                 # actionable stall artifact for CI / seed-range sweeps: the

@@ -306,8 +306,11 @@ class SimMessageSink(MessageSink):
     def __init__(self, node_id: int, cluster: "Cluster"):
         self.node_id = node_id
         self.cluster = cluster
-        # msg_id -> (callback, timeout_entry, to_node, rearm_attempt, sent_at)
-        self.callbacks: Dict[int, Tuple[Callback, object, int, int, int]] = {}
+        # msg_id -> (callback, timeout_entry, to_node, rearm_attempt, sent_at,
+        #            txn_id) — txn_id attributes timeout/backoff observability
+        # to the transaction's flight-recorder span (None for txn-less rounds)
+        self.callbacks: Dict[int, Tuple[Callback, object, int, int, int,
+                                        object]] = {}
         # gray-failure detector feeding read-speculation routing
         alpha, threshold_s, penalty_s = cluster.slow_peer_params
         self.slow_replicas = SlowReplicaTracker(cluster, alpha, threshold_s,
@@ -322,7 +325,7 @@ class SimMessageSink(MessageSink):
     def teardown(self) -> None:
         """Crash path: drop every registered callback and cancel its timeout
         entry (exact idle accounting — the timers must not pin the queue)."""
-        for _callback, timeout_entry, _to, _attempt, _sent in \
+        for _callback, timeout_entry, _to, _attempt, _sent, _tid in \
                 self.callbacks.values():
             timeout_entry.cancel()
         self.callbacks.clear()
@@ -355,7 +358,8 @@ class SimMessageSink(MessageSink):
         if callback is not None:
             entry = self._arm_timeout(msg_id, 0)
             self.callbacks[msg_id] = (callback, entry, to, 0,
-                                      cluster.queue.now_micros)
+                                      cluster.queue.now_micros,
+                                      getattr(request, "txn_id", None))
 
         def emit():
             cluster.route(self.node_id, to, request, msg_id,
@@ -392,7 +396,7 @@ class SimMessageSink(MessageSink):
         entry = self.callbacks.get(msg_id)
         if entry is None:
             return
-        callback, timeout_entry, to, attempt, sent_at = entry
+        callback, timeout_entry, to, attempt, sent_at, tid = entry
         now = self.cluster.queue.now_micros
         # per-LEG latency (send→first reply, reply→reply): measuring from the
         # original send would fold a txn's whole dependency wait into the
@@ -409,7 +413,10 @@ class SimMessageSink(MessageSink):
             timeout_entry.cancel()
             new_entry = self._arm_timeout(msg_id, attempt + 1)
             self.callbacks[msg_id] = (callback, new_entry, to, attempt + 1,
-                                      now)
+                                      now, tid)
+            if self.cluster.observer is not None:
+                self.cluster.observer.on_backoff(self.node_id, tid,
+                                                 attempt + 1)
         else:
             # re-arm budget exhausted — deliver the reply below but leave the
             # LAST armed timer standing; when it fires, the normal timeout
@@ -417,7 +424,7 @@ class SimMessageSink(MessageSink):
             # takes over from fresher information (bounded patience, never a
             # hang)
             self.callbacks[msg_id] = (callback, timeout_entry, to, attempt,
-                                      now)
+                                      now, tid)
         try:
             if isinstance(reply, FailureReply):
                 callback.on_failure(from_node, reply.failure)
@@ -433,7 +440,7 @@ class SimMessageSink(MessageSink):
         entry = self.callbacks.pop(msg_id, None)
         if entry is None:
             return
-        callback, timeout_entry, _, _attempt, _sent = entry
+        callback, timeout_entry, _, _attempt, _sent, _tid = entry
         timeout_entry.cancel()
         try:
             callback.on_failure(to_node, failure)
@@ -450,8 +457,11 @@ class SimMessageSink(MessageSink):
         entry = self.callbacks.pop(msg_id, None)
         if entry is None:
             return
-        callback, _timeout_entry, to, _attempt, _sent = entry
+        callback, _timeout_entry, to, _attempt, _sent, tid = entry
         self.slow_replicas.record_timeout(to)
+        if self.cluster.observer is not None:
+            self.cluster.observer.on_reply_timeout(
+                self.node_id, to, tid, self.cluster.queue.now_micros)
         try:
             callback.on_failure(to, Timeout(None, f"no reply from {to}"))
         except BaseException as e:  # noqa: BLE001
@@ -606,7 +616,8 @@ class Cluster:
                  journal: bool = False,
                  resolver: Optional[str] = None,
                  batch_window_us: int = 0,
-                 node_config=None):
+                 node_config=None,
+                 observer=None):
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
         self.scheduler = SimScheduler(self.queue)
@@ -615,6 +626,10 @@ class Cluster:
         # where event is the link action taken or "REPLY"/"REPLY_<action>"
         # (the reference's accord.impl.basic.Trace logger, Cluster.java:237-264)
         self.tracer: Optional[Callable] = None
+        # flight recorder (observe.FlightRecorder): passive metrics/span hooks
+        # fed from the same sites as the tracer plus the lifecycle planes;
+        # MUST have zero observer effect (no RNG, no wall clock, no scheduling)
+        self.observer = observer
         # controllable-delivery hook (MockCluster/Network capability,
         # impl/mock/MockCluster.java): fn(from, to, request, msg_id,
         # has_callback) -> True to swallow (the hook owns delivery/reply)
@@ -708,6 +723,15 @@ class Cluster:
         self._next_msg_id += 1
         return self._next_msg_id
 
+    def _trace(self, event: str, frm: int, to: int, msg_id, message) -> None:
+        """Report one message-plane event to the trace hook and the flight
+        recorder (both passive; the sim's behavior must not depend on them)."""
+        if self.tracer is not None:
+            self.tracer(event, frm, to, msg_id, message, self.queue.now_micros)
+        if self.observer is not None:
+            self.observer.on_message_event(event, frm, to, msg_id, message,
+                                           self.queue.now_micros)
+
     def _make_node(self, node_id: int, boot_epoch: Optional[int] = None) -> Node:
         """Construct one Node (initial boot or restart).  ``boot_epoch`` caps
         the topology the node initialises with (the epoch it had durably
@@ -740,6 +764,9 @@ class Cluster:
                 config=self._node_config)
         finally:
             svc.boot_cap = None
+        # flight-recorder wiring (survives restarts: every rebuilt incarnation
+        # reports into the same run-wide recorder)
+        node.observer = self.observer
         return node
 
     # -- pause lifecycle (the pause nemesis substrate) ------------------------
@@ -1038,9 +1065,7 @@ class Cluster:
             return
         if to_node in self.down:
             # connection refused: the sender observes it as a link failure
-            if self.tracer is not None:
-                self.tracer("DOWN", from_node, to_node, msg_id, request,
-                            self.queue.now_micros)
+            self._trace("DOWN", from_node, to_node, msg_id, request)
             if has_callback:
                 self.queue.add_after(
                     self.link.latency_us(from_node, to_node),
@@ -1050,9 +1075,7 @@ class Cluster:
             return
         action = self.link.action(from_node, to_node, request) if from_node != to_node \
             else LinkConfig.DELIVER
-        if self.tracer is not None:
-            self.tracer(action.upper(), from_node, to_node, msg_id, request,
-                        self.queue.now_micros)
+        self._trace(action.upper(), from_node, to_node, msg_id, request)
         if action in (LinkConfig.DROP, LinkConfig.FAILURE):
             if action == LinkConfig.FAILURE and has_callback:
                 self.queue.add_after(
@@ -1086,9 +1109,7 @@ class Cluster:
         node = self.nodes.get(to_node)
         if node is None:
             return
-        if self.tracer is not None:
-            self.tracer("RECV", from_node, to_node, ctx.msg_id, request,
-                        self.queue.now_micros)
+        self._trace("RECV", from_node, to_node, ctx.msg_id, request)
         node.receive(request, from_node, ctx)
 
     def route_reply(self, from_node: int, to_node: int, reply_context: ReplyContext,
@@ -1096,9 +1117,8 @@ class Cluster:
         self._count(f"{type(reply).__name__}")
         action = self.link.action(from_node, to_node, reply) if from_node != to_node \
             else LinkConfig.DELIVER
-        if self.tracer is not None:
-            self.tracer(f"RPLY_{action.upper()}", from_node, to_node,
-                        reply_context.msg_id, reply, self.queue.now_micros)
+        self._trace(f"RPLY_{action.upper()}", from_node, to_node,
+                    reply_context.msg_id, reply)
         if action in (LinkConfig.DROP, LinkConfig.FAILURE):
             return
         if to_node in self.down:
@@ -1111,9 +1131,8 @@ class Cluster:
                 return  # the recipient crashed while the reply was in flight
             if self._gate(to_node, deliver):
                 return  # paused recipient: the reply queues until resume
-            if self.tracer is not None:
-                self.tracer("RECV_RPLY", from_node, to_node,
-                            reply_context.msg_id, reply, self.queue.now_micros)
+            self._trace("RECV_RPLY", from_node, to_node,
+                        reply_context.msg_id, reply)
             self.sinks[to_node].deliver_reply(from_node, reply_context.msg_id,
                                               reply)
         self.queue.add_after(latency, deliver)
@@ -1181,9 +1200,7 @@ class Cluster:
             store.resolver.prefetch(specs)
         try:
             for (_at, _seq, request, frm, ctx), _h in with_specs:
-                if self.tracer is not None:
-                    self.tracer("RECV", frm, to_node, ctx.msg_id, request,
-                                self.queue.now_micros)
+                self._trace("RECV", frm, to_node, ctx.msg_id, request)
                 node.receive(request, frm, ctx)
         finally:
             for store in per_store:

@@ -84,6 +84,15 @@ def dump_wait_state(cluster: Cluster) -> str:
                                     if len(ready) > 12 else ""))
                 except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
                     lines.append(f"  device_frontier_ready=<error {e!r}>")
+    observer = getattr(cluster, "observer", None)
+    if observer is not None:
+        # metrics snapshot section (flight recorder): the full registry —
+        # message counts, lifecycle transitions, recovery attribution — in
+        # the same artifact CI already captures for stalls
+        try:
+            lines.append("metrics: " + observer.registry_json(cluster))
+        except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
+            lines.append(f"metrics: <error {e!r}>")
     return "\n".join(lines)
 
 

@@ -200,6 +200,7 @@ class SimpleProgressLog(ProgressLog):
         # still monitored; _resolve_blocked discovers the route first
         # (FindSomeRoute/RecoverWithSomeRoute capability)
         self.blocking[blocked_by] = _BlockingState(blocked_by, route)
+        self._observe("blocked_monitors")
 
     # -- the poll loop (SimpleProgressLog.run) --------------------------------
     def _poll(self) -> None:
@@ -292,8 +293,14 @@ class SimpleProgressLog(ProgressLog):
                 or command.save_status is SaveStatus.INVALIDATED
                 or command.save_status.is_truncated)
 
+    def _observe(self, kind: str) -> None:
+        obs = getattr(self.node, "observer", None)
+        if obs is not None:
+            obs.on_progress(kind, self.node.id, self.store.id)
+
     def _investigate(self, state: _CoordinateState) -> None:
         from ..coordinate.maybe_recover import maybe_recover
+        self._observe("investigations")
 
         def on_done(outcome, failure):
             current = self.coordinating.get(state.txn_id)
@@ -328,6 +335,7 @@ class SimpleProgressLog(ProgressLog):
         from ..coordinate.maybe_recover import ProgressToken
         from ..coordinate.recover import invalidate as do_invalidate, recover as do_recover
         from ..utils import async_ as au
+        self._observe("blocked_probes")
 
         if state.route is None:
             # route unknown (the txn was learned by id only): discover it

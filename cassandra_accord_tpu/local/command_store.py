@@ -156,6 +156,12 @@ class CommandStore:
         self.resolver = make_resolver(getattr(node, "resolver_kind", "cpu"),
                                       self, config=getattr(node, "config", None))
 
+    def observer(self):
+        """The run's flight recorder (observe.FlightRecorder), or None.
+        Lives on the Node so every store of every incarnation reports into
+        the same run-wide recorder."""
+        return getattr(self.node, "observer", None)
+
     # -- ranges -------------------------------------------------------------
     def update_ranges(self, epoch: int, ranges: Ranges) -> None:
         self.ranges_by_epoch[epoch] = ranges
@@ -321,6 +327,10 @@ class SafeCommandStore:
         store.cold.add(txn_id)
         store.cold_summaries[txn_id] = CommandSummary(cmd)
         store.journal.on_evict(store, txn_id)
+        obs = store.observer()
+        if obs is not None:
+            obs.registry.counter("store.evictions", node=store.node.id,
+                                 store=store.id).inc()
         return True
 
     # -- cfk ----------------------------------------------------------------

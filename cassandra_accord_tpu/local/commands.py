@@ -35,6 +35,19 @@ class AcceptOutcome(enum.Enum):
     TRUNCATED = 4
 
 
+def _observe_transition(safe_store: SafeCommandStore, command: Command) -> None:
+    """Report a just-applied SaveStatus transition to the run's flight
+    recorder (observe.FlightRecorder) — the per-node/per-store txn lifecycle
+    span plane.  Passive by contract: reads sim time, touches no RNG and
+    schedules nothing (zero observer effect)."""
+    store = safe_store.store
+    obs = store.observer()
+    if obs is not None:
+        obs.on_transition(store.node.id, store.id, command.txn_id,
+                          command.save_status.name,
+                          safe_store.time().now_micros())
+
+
 # ---------------------------------------------------------------------------
 # PreAccept (Commands.java:113)
 # ---------------------------------------------------------------------------
@@ -81,6 +94,7 @@ def preaccept(safe_store: SafeCommandStore, txn_id: TxnId, partial_txn: PartialT
     else:
         command.execute_at = safe_store.time().unique_now_at_least(max_conflict)
     command.set_save_status(SaveStatus.PRE_ACCEPTED)
+    _observe_transition(safe_store, command)
     safe_store.register_witness(command, InternalStatus.PREACCEPTED)
     safe_store.progress_log().pre_accepted(command, _is_progress_shard(safe_store, command))
     safe_store.journal_save(command)
@@ -136,6 +150,7 @@ def accept(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: R
     command.execute_at = execute_at
     command.partial_deps = partial_deps
     command.set_save_status(SaveStatus.ACCEPTED)
+    _observe_transition(safe_store, command)
     safe_store.register_witness(command, InternalStatus.ACCEPTED)
     safe_store.progress_log().accepted(command, _is_progress_shard(safe_store, command))
     safe_store.journal_save(command)
@@ -168,6 +183,7 @@ def accept_invalidate(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballo
     command.accepted_or_committed = command.accepted_or_committed.merge_max(ballot)
     if command.save_status < SaveStatus.ACCEPTED_INVALIDATE:
         command.set_save_status(SaveStatus.ACCEPTED_INVALIDATE)
+        _observe_transition(safe_store, command)
     safe_store.journal_save(command)
     safe_store.notify_listeners(command)
     return AcceptOutcome.SUCCESS
@@ -192,6 +208,7 @@ def precommit(safe_store: SafeCommandStore, txn_id: TxnId, execute_at: Timestamp
         return CommitOutcome.REDUNDANT
     command.execute_at = execute_at
     command.set_save_status(SaveStatus.PRE_COMMITTED)
+    _observe_transition(safe_store, command)
     safe_store.journal_save(command)
     safe_store.progress_log().precommitted(command)
     safe_store.notify_listeners(command)
@@ -229,6 +246,7 @@ def commit(safe_store: SafeCommandStore, txn_id: TxnId, save_status: SaveStatus,
     command.execute_at = execute_at
     command.partial_deps = partial_deps
     command.set_save_status(save_status)
+    _observe_transition(safe_store, command)
     safe_store.register_witness(command, InternalStatus.COMMITTED if save_status is SaveStatus.COMMITTED
                                 else InternalStatus.STABLE)
     safe_store.journal_save(command)
@@ -270,6 +288,7 @@ def adopt_truncated_outcome(safe_store: SafeCommandStore, command: Command,
         command.partial_deps = None
         command.waiting_on = None
         command.set_save_status(SaveStatus.TRUNCATED_APPLY)
+        _observe_transition(safe_store, command)
         safe_store.journal_save(command)
         safe_store.register_witness(command, InternalStatus.APPLIED)
         safe_store.progress_log().clear(command.txn_id)
@@ -294,6 +313,7 @@ def commit_invalidate(safe_store: SafeCommandStore, txn_id: TxnId,
     if command.save_status is SaveStatus.INVALIDATED:
         return
     command.set_save_status(SaveStatus.INVALIDATED)
+    _observe_transition(safe_store, command)
     safe_store.journal_save(command)
     safe_store.register_witness(command, InternalStatus.INVALIDATED)
     safe_store.progress_log().invalidated(command, _is_progress_shard(safe_store, command))
@@ -342,6 +362,7 @@ def apply_(safe_store: SafeCommandStore, txn_id: TxnId, route: Route,
     if command.waiting_on is None:
         initialise_waiting_on(safe_store, command)
     command.set_save_status(SaveStatus.PRE_APPLIED)
+    _observe_transition(safe_store, command)
     safe_store.journal_save(command)
     safe_store.register_witness(command, InternalStatus.COMMITTED)
     maybe_execute(safe_store, command, always_notify_listeners=True)
@@ -565,12 +586,14 @@ def maybe_execute(safe_store: SafeCommandStore, command: Command,
             store.exec_deferred.add(command.txn_id)
             return False
         command.set_save_status(SaveStatus.READY_TO_EXECUTE)
+        _observe_transition(safe_store, command)
         safe_store.progress_log().ready_to_execute(command)
         safe_store.notify_listeners(command)
         return True
 
     # PRE_APPLIED -> Applying -> Applied
     command.set_save_status(SaveStatus.APPLYING)
+    _observe_transition(safe_store, command)
     _apply_writes(safe_store, command)
     return True
 
@@ -595,6 +618,7 @@ def _apply_writes(safe_store: SafeCommandStore, command: Command) -> None:
                                               command.execute_at, True,
                                               txn_id=command.txn_id)
         command.set_save_status(SaveStatus.APPLIED)
+        _observe_transition(safe_store, command)
         command.applied_locally = True
         safe_store.journal_save(command)
         safe_store.register_witness(command, InternalStatus.APPLIED)
@@ -677,6 +701,7 @@ def install_quarantine_tombstone(safe_store: SafeCommandStore,
     command = Command(txn_id)
     command.save_status = SaveStatus.ERASED
     safe_store.store.commands[txn_id] = command
+    _observe_transition(safe_store, command)
     safe_store.journal_save(command)
     return command
 
@@ -725,6 +750,7 @@ def replay_journal(safe_store: SafeCommandStore, rebuilt,
         # the journal tracks them, so the store must keep tracking them or the
         # end-of-burn persistence check reads the gap as an untracked erasure
         store.commands[txn_id] = command
+        _observe_transition(safe_store, command)   # timeline: replayed tier
         status = _REPLAY_WITNESS.get(command.save_status)
         if status is not None:
             safe_store.register_witness(command, status)
@@ -802,6 +828,7 @@ def truncate(safe_store: SafeCommandStore, command: Command, cleanup) -> None:
         command.writes = None
         command.result = None
         command.set_save_status(SaveStatus.ERASED)
+    _observe_transition(safe_store, command)
     safe_store.journal_save(command)
     # waiters must LEARN of the truncation (a truncated dep no longer blocks,
     # _still_blocks) — clearing their registrations silently would strand them

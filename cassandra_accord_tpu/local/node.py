@@ -54,6 +54,11 @@ class Node(ConfigurationService.Listener):
         from ..impl.resolver import check_resolver_kind
         self.resolver_kind = check_resolver_kind(
             resolver if resolver is not None else self.config.resolver_kind)
+        # flight recorder (observe.FlightRecorder) — assigned by the harness
+        # cluster after construction; None outside instrumented runs.  Hooks
+        # must stay passive (zero observer effect): they may read sim state
+        # but never touch RNG, wall clock, or scheduling.
+        self.observer = None
         self.topology = TopologyManager(node_id)
         self._epoch_watchdogs: set = set()
         self.command_stores = CommandStores(self, num_shards, executor_factory)

@@ -1,0 +1,83 @@
+"""Device data-plane metrics: one source for resolver counters and kernel
+roofline accounting.
+
+The TPU deps resolver's ad-hoc counters (consult tier choices, prefetch
+hit/miss/patch) and the kernel-level roofline numbers (join FLOPs, index
+bytes, MFU vs peak) previously lived in two places — burn-result stats and
+``bench.py`` JSON tails — with the formulas duplicated.  Both now report
+through here.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .schema import RESOLVER_COUNTERS, RESOLVER_METRICS
+
+# one v5p-class chip's bf16 matmul peak, the MFU denominator bench.py reports
+PEAK_BF16_TFLOPS = 275.0
+
+
+def resolver_counters(resolver) -> Optional[Dict[str, int]]:
+    """The standard counter dict for one store's resolver (unwrapping the
+    verify resolver to its device half), or None when the store runs a plain
+    host resolver with no telemetry."""
+    r = getattr(resolver, "tpu", resolver)
+    if not hasattr(r, RESOLVER_COUNTERS[0]):
+        return None
+    return {name: getattr(r, name) for name in RESOLVER_COUNTERS}
+
+
+def cluster_resolver_totals(cluster) -> Dict[str, int]:
+    """Sum of every store's resolver counters (the burn-result telemetry
+    block).  Zero-filled keys when no telemetry-bearing resolver exists so
+    callers can test ``any(tel.values())``."""
+    totals = {name: 0 for name in RESOLVER_COUNTERS}
+    for node in cluster.nodes.values():
+        for store in node.command_stores.all_stores():
+            counters = resolver_counters(store.resolver)
+            if counters is not None:
+                for name, value in counters.items():
+                    totals[name] += value
+    return totals
+
+
+def collect_into(registry, cluster) -> None:
+    """Pull-collect per-store resolver counters (and cluster totals) into a
+    MetricsRegistry as gauges under the schema's ``resolver.*`` names."""
+    totals = {name: 0 for name in RESOLVER_COUNTERS}
+    seen = False
+    for node in cluster.nodes.values():
+        for store in node.command_stores.all_stores():
+            counters = resolver_counters(store.resolver)
+            if counters is None:
+                continue
+            seen = True
+            for name, value in counters.items():
+                registry.gauge(RESOLVER_METRICS[name], node=node.id,
+                               store=store.id).set(value)
+                totals[name] += value
+    if seen:
+        for name, value in totals.items():
+            registry.gauge(RESOLVER_METRICS[name]).set(value)
+
+
+# -- kernel roofline accounting (bench.py) -----------------------------------
+
+def consult_join_flops(b: int, k: int, t: int) -> float:
+    """Matmul FLOPs of one fused consult launch: a [B,K]x[K,T] join."""
+    return 2.0 * b * k * t
+
+
+def index_bytes_int8(t: int, k: int) -> int:
+    """Resident bytes of the int8 incidence index (key_inc + live mirror)."""
+    return 2 * t * k
+
+
+def kernel_consult_metrics(t: int, k: int, b: int,
+                           device_qps: float) -> Dict[str, float]:
+    """Roofline block for one consult-kernel measurement: achieved join
+    TFLOP/s and MFU against the chip's bf16 peak."""
+    tflops = device_qps / b * consult_join_flops(b, k, t) / 1e12
+    return {"index_bytes_int8": index_bytes_int8(t, k),
+            "device_join_tflops": round(tflops, 4),
+            "consult_mfu_vs_275tflops": round(tflops / PEAK_BF16_TFLOPS, 5)}

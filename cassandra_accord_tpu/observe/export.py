@@ -1,0 +1,137 @@
+"""Chrome trace-event export: load a burn's flight recording in Perfetto.
+
+Produces the JSON object format of the Trace Event spec
+(``{"traceEvents": [...]}``, timestamps in MICROseconds — exactly the
+simulator's native unit):
+
+- pid = node id, tid 0 = that node's coordinator track, tid = store id + 1
+  for its command-store tracks (``M`` metadata events name them);
+- one ``X`` (complete) event per client txn on the coordinator track,
+  spanning submit→resolve, args carrying path/outcome/recovery attribution;
+- per-(node, store) ``X`` events for each status segment of a txn's
+  lifecycle (PRE_ACCEPTED until ACCEPTED, ... until the next transition),
+  with an ``i`` (instant) event for the terminal status;
+- optional ``i`` events for raw message routing (SEND/DROP/RECV...), on the
+  sending node's coordinator track.
+
+``validate_chrome_trace`` is the schema check the tier-1 tests run over
+every export.
+"""
+from __future__ import annotations
+
+import json
+from typing import List
+
+_VALID_PHASES = {"X", "i", "M", "B", "E"}
+
+
+def _span_events(span) -> List[dict]:
+    events: List[dict] = []
+    tid_str = str(span.txn_id)
+    if span.is_client_op:
+        end = span.resolved_us if span.resolved_us is not None \
+            else span.submitted_us
+        events.append({
+            "name": f"txn {tid_str}", "cat": "txn", "ph": "X",
+            "ts": span.submitted_us,
+            "dur": max(end - span.submitted_us, 1),
+            "pid": span.coordinator, "tid": 0,
+            "args": {"txn_id": tid_str, "op_id": span.op_id,
+                     "path": span.path, "outcome": span.outcome,
+                     "recoveries": span.recoveries,
+                     "invalidate_attempts": span.invalidate_attempts,
+                     "timeouts": span.timeouts, "backoffs": span.backoffs},
+        })
+    for (node, store), transitions in sorted(span.transitions.items()):
+        for i, (status, ts) in enumerate(transitions):
+            args = {"txn_id": tid_str, "status": status}
+            if i + 1 < len(transitions):
+                dur = max(transitions[i + 1][1] - ts, 1)
+                events.append({"name": status, "cat": "lifecycle", "ph": "X",
+                               "ts": ts, "dur": dur, "pid": node,
+                               "tid": store + 1, "args": args})
+            else:
+                events.append({"name": status, "cat": "lifecycle", "ph": "i",
+                               "s": "t", "ts": ts, "pid": node,
+                               "tid": store + 1, "args": args})
+    return events
+
+
+def chrome_trace(recorder, include_messages: bool = True) -> dict:
+    """Render a FlightRecorder as a Chrome trace-event JSON object."""
+    events: List[dict] = []
+    pids = set()
+    tids = set()        # (pid, tid)
+    for span in recorder.spans.spans.values():
+        for ev in _span_events(span):
+            pids.add(ev["pid"])
+            tids.add((ev["pid"], ev["tid"]))
+            events.append(ev)
+    if include_messages:
+        for seq, ts, event, frm, to, msg_id, brief in recorder.messages:
+            pids.add(frm)
+            tids.add((frm, 0))
+            events.append({"name": f"{event} {brief}", "cat": "msg",
+                           "ph": "i", "s": "t", "ts": ts, "pid": frm,
+                           "tid": 0,
+                           "args": {"seq": seq, "to": to, "event": event,
+                                    "msg_id": msg_id}})
+    meta: List[dict] = []
+    for pid in sorted(pids):
+        meta.append({"name": "process_name", "ph": "M", "ts": 0, "pid": pid,
+                     "tid": 0, "args": {"name": f"node {pid}"}})
+    for pid, tid in sorted(tids):
+        name = "coordinator" if tid == 0 else f"store {tid - 1}"
+        meta.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+                     "tid": tid, "args": {"name": name}})
+    events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"]))
+    return {"traceEvents": meta + events,
+            "displayTimeUnit": "ms",
+            "otherData": {"source": "cassandra_accord_tpu flight recorder",
+                          "time_unit": "simulated_micros",
+                          "dropped_messages": recorder.dropped_messages}}
+
+
+def write_chrome_trace(path: str, recorder,
+                       include_messages: bool = True) -> None:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(recorder, include_messages=include_messages),
+                  f, sort_keys=True)
+        f.write("\n")
+
+
+def validate_chrome_trace(doc) -> List[str]:
+    """Schema check; returns a list of problems ([] = loadable)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return ["not an object with a traceEvents array"]
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        return ["traceEvents is not a list"]
+    for i, ev in enumerate(events):
+        ctx = f"event[{i}]"
+        if not isinstance(ev, dict):
+            problems.append(f"{ctx}: not an object")
+            continue
+        for field in ("name", "ph", "ts", "pid", "tid"):
+            if field not in ev:
+                problems.append(f"{ctx}: missing {field!r}")
+        ph = ev.get("ph")
+        if ph not in _VALID_PHASES:
+            problems.append(f"{ctx}: bad phase {ph!r}")
+        ts = ev.get("ts")
+        if not isinstance(ts, int) or ts < 0:
+            problems.append(f"{ctx}: ts must be a non-negative int, got {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, int) or dur <= 0:
+                problems.append(f"{ctx}: X event needs a positive int dur")
+        if "args" in ev:
+            try:
+                json.dumps(ev["args"])
+            except TypeError:
+                problems.append(f"{ctx}: args not JSON-serializable")
+        if len(problems) > 20:
+            problems.append("... (truncated)")
+            break
+    return problems

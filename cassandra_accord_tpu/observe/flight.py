@@ -1,0 +1,175 @@
+"""The flight recorder: every observability hook the instrumented tree calls.
+
+One ``FlightRecorder`` per run bundles the metrics registry, the txn span
+recorder, and a (optionally ring-bounded) message event buffer, and exposes
+the ``on_*`` hooks wired through ``harness/cluster.py`` (message routing,
+reply timeouts/backoff), ``coordinate/`` (path classification, recovery
+attribution), ``local/commands.py`` (status transitions) and
+``local/progress_log.py`` (investigation launches).
+
+All hooks obey the zero-observer-effect contract (see ``observe/__init__``):
+they consume values the caller already computed and never touch RNG, wall
+clock, or the event loop.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..harness.trace import Trace
+from . import device as device_metrics
+from . import schema
+from .registry import MetricsRegistry
+from .spans import TxnSpanRecorder
+
+# link-action / routing events the cluster reports for an OUTBOUND packet
+# (the reply family is prefixed RPLY_); RECV/RECV_RPLY are deliveries
+_SEND_EVENTS = ("DELIVER", "DROP", "FAILURE", "DELIVER_WITH_FAILURE", "DOWN")
+
+
+def _message_metric(message) -> str:
+    """Schema metric name for a message instance; total (never raises)."""
+    try:
+        return schema.metric_for_message(message.type.name)
+    except Exception:  # noqa: BLE001 — unregistered/legacy message classes
+        return f"msg.unregistered.{type(message).__name__}"
+
+
+class FlightRecorder:
+    """Metrics + spans + message events for one deterministic run."""
+
+    def __init__(self, message_ring: Optional[int] = None,
+                 record_messages: bool = True):
+        self.registry = MetricsRegistry()
+        self.spans = TxnSpanRecorder()
+        self.record_messages = record_messages
+        # the message timeline IS a Trace (same event tuples, same optional
+        # ring bound) — one ring-buffer implementation, not two
+        self._message_trace = Trace(keep_last=message_ring)
+
+    @property
+    def messages(self):
+        return self._message_trace.events
+
+    @property
+    def dropped_messages(self) -> int:
+        return self._message_trace.dropped
+
+    # -- message plane (cluster.route / route_reply / _deliver) --------------
+    def on_message_event(self, event: str, frm: int, to: int, msg_id,
+                         message, now_us: int) -> None:
+        reg = self.registry
+        if event in _SEND_EVENTS:
+            name = _message_metric(message)
+            reg.counter(name).inc()
+            reg.counter(name, node=frm).inc()
+            reg.counter(f"link.{event.lower()}").inc()
+        elif event.startswith("RPLY_"):
+            name = _message_metric(message)
+            reg.counter(name).inc()
+            reg.counter(name, node=frm).inc()
+            reg.counter(f"link.reply_{event[5:].lower()}").inc()
+        else:   # RECV / RECV_RPLY: the delivery, counted at the receiver
+            reg.counter("msg.received", node=to).inc()
+        if self.record_messages:
+            self._message_trace.hook(event, frm, to, msg_id, message, now_us)
+
+    def on_reply_timeout(self, node: int, peer: int, txn_id,
+                         now_us: int) -> None:
+        self.registry.counter("net.reply_timeouts").inc()
+        self.registry.counter("net.reply_timeouts", node=node).inc()
+        self.spans.on_timeout(txn_id)
+
+    def on_backoff(self, node: int, txn_id, attempt: int) -> None:
+        self.registry.counter("net.backoff_rearms").inc()
+        self.registry.counter("net.backoff_rearms", node=node).inc()
+        self.spans.on_backoff(txn_id)
+
+    # -- client envelope (harness/burn.py) -----------------------------------
+    def on_submit(self, op_id: int, txn_id, coordinator: int,
+                  now_us: int) -> None:
+        self.spans.on_submit(op_id, txn_id, coordinator, now_us)
+        self.registry.counter(schema.SUBMITTED_METRIC).inc()
+        self.registry.counter(schema.SUBMITTED_METRIC, node=coordinator).inc()
+
+    def on_resolve(self, txn_id, kind: str, now_us: int) -> None:
+        outcome = self.spans.on_resolve(txn_id, kind, now_us)
+        self.registry.counter(schema.OUTCOME_METRICS[outcome]).inc()
+        span = self.spans.spans[txn_id]
+        if span.submitted_us is not None:
+            self.registry.histogram(schema.LATENCY_METRIC) \
+                .record(now_us - span.submitted_us)
+
+    # -- coordination classification (coordinate/) ---------------------------
+    def on_path(self, txn_id, path: str,
+                fast_path_votes=None) -> None:
+        self.spans.on_path(txn_id, path)
+        self.registry.counter(f"txn.path.{path}").inc()
+        if fast_path_votes is not None:
+            accepts, rejects = fast_path_votes
+            self.registry.counter("txn.fastpath.votes_accept").inc(accepts)
+            self.registry.counter("txn.fastpath.votes_reject").inc(rejects)
+
+    def on_recovery(self, node: int, txn_id, ballot=None) -> None:
+        self.spans.on_recovery(txn_id)
+        self.registry.counter("recovery.attempts").inc()
+        self.registry.counter("recovery.attempts", node=node).inc()
+
+    def on_invalidate(self, node: int, txn_id) -> None:
+        self.spans.on_invalidate_attempt(txn_id)
+        self.registry.counter("recovery.invalidate_attempts").inc()
+        self.registry.counter("recovery.invalidate_attempts", node=node).inc()
+
+    # -- replica-side lifecycle (local/commands.py) --------------------------
+    def on_transition(self, node: int, store: int, txn_id,
+                      status_name: str, now_us: int) -> None:
+        self.spans.on_transition(node, store, txn_id, status_name, now_us)
+        name = schema.metric_for_save_status(status_name)
+        self.registry.counter(name).inc()
+        self.registry.counter(name, node=node, store=store).inc()
+
+    # -- progress-log liveness machinery (local/progress_log.py) -------------
+    def on_progress(self, kind: str, node: int,
+                    store: Optional[int] = None) -> None:
+        self.registry.counter(f"progress.{kind}").inc()
+        self.registry.counter(f"progress.{kind}", node=node, store=store).inc()
+
+    # -- pull collection (end of run / watchdog dump) ------------------------
+    def collect_cluster(self, cluster) -> None:
+        """Pull-collect cluster/stores state as gauges: simulator stats
+        (message counts, fault injections), per-store size/diagnostic
+        counters, and the device-resolver counters."""
+        reg = self.registry
+        for key, value in cluster.stats.items():
+            reg.gauge(f"sim.{key}").set(value)
+        for node in cluster.nodes.values():
+            for cs in node.command_stores.all_stores():
+                reg.gauge("store.commands", node=node.id,
+                          store=cs.id).set(len(cs.commands))
+                reg.gauge("store.cold", node=node.id,
+                          store=cs.id).set(len(cs.cold))
+                reg.gauge("store.exec_deferred", node=node.id,
+                          store=cs.id).set(len(cs.exec_deferred))
+                reg.gauge("store.cache_miss_loads", node=node.id,
+                          store=cs.id).set(cs.cache_miss_loads)
+                reg.gauge("store.tfk_inversions", node=node.id,
+                          store=cs.id).set(cs.tfk_inversions)
+        device_metrics.collect_into(reg, cluster)
+
+    # -- rendering -----------------------------------------------------------
+    def metrics_snapshot(self, cluster=None) -> dict:
+        if cluster is not None:
+            self.collect_cluster(cluster)
+        return self.registry.snapshot()
+
+    def registry_json(self, cluster=None) -> str:
+        if cluster is not None:
+            self.collect_cluster(cluster)
+        return self.registry.to_json()
+
+    def chrome_trace(self) -> dict:
+        from .export import chrome_trace
+        return chrome_trace(self)
+
+    def write_trace(self, path: str) -> None:
+        from .export import write_chrome_trace
+        write_chrome_trace(path, self)

@@ -1,0 +1,137 @@
+"""The canonical metric-name schema — the registry completeness contract.
+
+Every wire ``MessageType`` and every ``Status``/``SaveStatus`` member has an
+EXPLICIT entry here.  The dicts are written out (not derived from the enums)
+on purpose: ``tests/test_observe.py`` asserts exact two-way agreement with
+the enums, so a NEW message type or status phase cannot ship unobserved —
+adding the enum member without a metric name fails tier-1, and a stale entry
+for a removed/renamed member fails it too.
+"""
+from __future__ import annotations
+
+# -- message plane (messages/base.py MessageType) ----------------------------
+
+MESSAGE_METRICS = {
+    "SIMPLE_RSP": "msg.simple_rsp",
+    "FAILURE_RSP": "msg.failure_rsp",
+    "PRE_ACCEPT_REQ": "msg.pre_accept_req",
+    "PRE_ACCEPT_RSP": "msg.pre_accept_rsp",
+    "ACCEPT_REQ": "msg.accept_req",
+    "ACCEPT_RSP": "msg.accept_rsp",
+    "ACCEPT_INVALIDATE_REQ": "msg.accept_invalidate_req",
+    "GET_DEPS_REQ": "msg.get_deps_req",
+    "GET_DEPS_RSP": "msg.get_deps_rsp",
+    "GET_EPHEMERAL_READ_DEPS_REQ": "msg.get_ephemeral_read_deps_req",
+    "GET_EPHEMERAL_READ_DEPS_RSP": "msg.get_ephemeral_read_deps_rsp",
+    "GET_MAX_CONFLICT_REQ": "msg.get_max_conflict_req",
+    "GET_MAX_CONFLICT_RSP": "msg.get_max_conflict_rsp",
+    "COMMIT_SLOW_PATH_REQ": "msg.commit_slow_path_req",
+    "COMMIT_MAXIMAL_REQ": "msg.commit_maximal_req",
+    "STABLE_FAST_PATH_REQ": "msg.stable_fast_path_req",
+    "STABLE_SLOW_PATH_REQ": "msg.stable_slow_path_req",
+    "STABLE_MAXIMAL_REQ": "msg.stable_maximal_req",
+    "COMMIT_INVALIDATE_REQ": "msg.commit_invalidate_req",
+    "APPLY_MINIMAL_REQ": "msg.apply_minimal_req",
+    "APPLY_MAXIMAL_REQ": "msg.apply_maximal_req",
+    "APPLY_RSP": "msg.apply_rsp",
+    "READ_REQ": "msg.read_req",
+    "READ_EPHEMERAL_REQ": "msg.read_ephemeral_req",
+    "READ_RSP": "msg.read_rsp",
+    "BEGIN_RECOVER_REQ": "msg.begin_recover_req",
+    "BEGIN_RECOVER_RSP": "msg.begin_recover_rsp",
+    "BEGIN_INVALIDATE_REQ": "msg.begin_invalidate_req",
+    "BEGIN_INVALIDATE_RSP": "msg.begin_invalidate_rsp",
+    "WAIT_ON_COMMIT_REQ": "msg.wait_on_commit_req",
+    "WAIT_ON_COMMIT_RSP": "msg.wait_on_commit_rsp",
+    "WAIT_UNTIL_APPLIED_REQ": "msg.wait_until_applied_req",
+    "APPLY_THEN_WAIT_UNTIL_APPLIED_REQ":
+        "msg.apply_then_wait_until_applied_req",
+    "RECOVER_AWAIT_REQ": "msg.recover_await_req",
+    "CHECK_STATUS_REQ": "msg.check_status_req",
+    "CHECK_STATUS_RSP": "msg.check_status_rsp",
+    "FETCH_DATA_REQ": "msg.fetch_data_req",
+    "FETCH_DATA_RSP": "msg.fetch_data_rsp",
+    "SET_SHARD_DURABLE_REQ": "msg.set_shard_durable_req",
+    "SET_GLOBALLY_DURABLE_REQ": "msg.set_globally_durable_req",
+    "QUERY_DURABLE_BEFORE_REQ": "msg.query_durable_before_req",
+    "QUERY_DURABLE_BEFORE_RSP": "msg.query_durable_before_rsp",
+    "INFORM_OF_TXN_REQ": "msg.inform_of_txn_req",
+    "FIND_ROUTE_REQ": "msg.find_route_req",
+    "FIND_ROUTE_RSP": "msg.find_route_rsp",
+    "INFORM_DURABLE_REQ": "msg.inform_durable_req",
+    "INFORM_HOME_DURABLE_REQ": "msg.inform_home_durable_req",
+    "PROPAGATE_PRE_ACCEPT_MSG": "msg.propagate_pre_accept_msg",
+    "PROPAGATE_STABLE_MSG": "msg.propagate_stable_msg",
+    "PROPAGATE_APPLY_MSG": "msg.propagate_apply_msg",
+    "PROPAGATE_OTHER_MSG": "msg.propagate_other_msg",
+}
+
+# -- txn status lattice (local/status.py) ------------------------------------
+
+STATUS_METRICS = {
+    "NOT_DEFINED": "txn.status.not_defined",
+    "PRE_ACCEPTED": "txn.status.pre_accepted",
+    "ACCEPTED_INVALIDATE": "txn.status.accepted_invalidate",
+    "ACCEPTED": "txn.status.accepted",
+    "PRE_COMMITTED": "txn.status.pre_committed",
+    "COMMITTED": "txn.status.committed",
+    "STABLE": "txn.status.stable",
+    "PRE_APPLIED": "txn.status.pre_applied",
+    "APPLIED": "txn.status.applied",
+    "TRUNCATED": "txn.status.truncated",
+    "INVALIDATED": "txn.status.invalidated",
+}
+
+SAVE_STATUS_METRICS = {
+    "NOT_DEFINED": "txn.save_status.not_defined",
+    "PRE_ACCEPTED": "txn.save_status.pre_accepted",
+    "ACCEPTED_INVALIDATE": "txn.save_status.accepted_invalidate",
+    "ACCEPTED": "txn.save_status.accepted",
+    "PRE_COMMITTED": "txn.save_status.pre_committed",
+    "COMMITTED": "txn.save_status.committed",
+    "STABLE": "txn.save_status.stable",
+    "READY_TO_EXECUTE": "txn.save_status.ready_to_execute",
+    "PRE_APPLIED": "txn.save_status.pre_applied",
+    "APPLYING": "txn.save_status.applying",
+    "APPLIED": "txn.save_status.applied",
+    "TRUNCATED_APPLY": "txn.save_status.truncated_apply",
+    "ERASED": "txn.save_status.erased",
+    "INVALIDATED": "txn.save_status.invalidated",
+}
+
+# -- coordinator-side resolution classes (harness/burn.py resolve kinds) -----
+# Every submitted op resolves as exactly ONE of these; the flight recorder's
+# span accounting asserts sum(outcomes) == submitted (tier-1).
+
+OUTCOMES = ("fast", "slow", "recovered", "invalidated", "lost", "failed")
+OUTCOME_METRICS = {o: f"txn.resolved.{o}" for o in OUTCOMES}
+
+SUBMITTED_METRIC = "txn.submitted"
+LATENCY_METRIC = "txn.latency_us"
+
+# -- device data plane (impl/tpu_resolver.py counters) -----------------------
+
+RESOLVER_COUNTERS = ("prefetch_hits", "prefetch_patched", "prefetch_misses",
+                     "walk_consults", "host_consults", "native_consults",
+                     "device_consults")
+RESOLVER_METRICS = {c: f"resolver.{c}" for c in RESOLVER_COUNTERS}
+
+
+def metric_for_message(type_name: str) -> str:
+    """Registry name for a MessageType member; KeyError (with the fix) for an
+    unregistered one — the lint test turns that into a tier-1 failure."""
+    try:
+        return MESSAGE_METRICS[type_name]
+    except KeyError:
+        raise KeyError(
+            f"MessageType.{type_name} has no metric name: add it to "
+            f"observe/schema.py MESSAGE_METRICS") from None
+
+
+def metric_for_save_status(status_name: str) -> str:
+    try:
+        return SAVE_STATUS_METRICS[status_name]
+    except KeyError:
+        raise KeyError(
+            f"SaveStatus.{status_name} has no metric name: add it to "
+            f"observe/schema.py SAVE_STATUS_METRICS") from None

@@ -1,0 +1,369 @@
+"""The observability layer: metrics registry, txn lifecycle spans, flight
+recorder, Chrome-trace export — and its two hard contracts:
+
+1. ZERO OBSERVER EFFECT: a same-seed hostile burn with the flight recorder
+   on vs off yields byte-identical full message traces and identical
+   final-state outcome counters (the recorder's hooks may never allocate ids
+   from shared RNG, read wall-clock, or alter scheduling).
+2. REGISTRY COMPLETENESS: every wire MessageType and every Status/SaveStatus
+   member has an explicit metric name (two-way agreement with the enums), so
+   a new message or phase cannot ship unobserved.
+"""
+import json
+
+import pytest
+
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+from cassandra_accord_tpu.local.status import SaveStatus, Status
+from cassandra_accord_tpu.messages.base import MessageType
+from cassandra_accord_tpu.observe import (FlightRecorder, MetricsRegistry,
+                                          validate_chrome_trace)
+from cassandra_accord_tpu.observe import schema
+from cassandra_accord_tpu.observe.registry import Histogram
+
+HOSTILE = dict(ops=40, concurrency=8, chaos=True, allow_failures=True,
+               durability=True, journal=True, delayed_stores=True,
+               clock_drift=True, max_tasks=3_000_000)
+
+
+# ---------------------------------------------------------------------------
+# registry primitives
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram():
+    reg = MetricsRegistry()
+    reg.counter("a").inc()
+    reg.counter("a").inc(4)
+    reg.gauge("g", node=1).set(7)
+    h = reg.histogram("h", node=1, store=0, bounds=(10, 100))
+    h.record(5)
+    h.record(50)
+    h.record(5000)
+    snap = reg.snapshot()
+    assert snap["cluster"]["a"] == 5
+    assert snap["node/1"]["g"] == 7
+    hs = snap["store/1/0"]["h"]
+    assert hs["count"] == 3 and hs["total"] == 5055
+    assert hs["buckets"] == [1, 1, 1]   # <=10, <=100, overflow
+
+
+def test_registry_rejects_kind_mismatch():
+    reg = MetricsRegistry()
+    reg.counter("x")
+    with pytest.raises(TypeError):
+        reg.gauge("x")
+
+
+def test_registry_rejects_histogram_bounds_mismatch():
+    reg = MetricsRegistry()
+    reg.histogram("h", bounds=(10, 100))
+    with pytest.raises(ValueError, match="bounds"):
+        reg.histogram("h")   # default bounds differ: loud, not first-wins
+    reg.histogram("h", bounds=(10, 100)).record(5)   # same bounds: fine
+
+
+def test_snapshot_delta_merge():
+    a = MetricsRegistry()
+    b = MetricsRegistry()
+    a.counter("n").inc(10)
+    b.counter("n").inc(3)
+    b.counter("only_b").inc(2)
+    for reg, vals in ((a, (5, 500)), (b, (5,))):
+        h = reg.histogram("h", bounds=(10, 100))
+        for v in vals:
+            h.record(v)
+    sa, sb = a.snapshot(), b.snapshot()
+    d = MetricsRegistry.delta(sa, sb)
+    assert d["cluster"]["n"] == 7
+    assert d["cluster"]["only_b"] == -2
+    assert d["cluster"]["h"]["count"] == 1
+    assert d["cluster"]["h"]["buckets"] == [0, 0, 1]
+    m = MetricsRegistry.merge(sa, sb)
+    assert m["cluster"]["n"] == 13
+    assert m["cluster"]["h"]["count"] == 3
+
+
+def test_snapshot_json_stable():
+    """Same content in any insertion order renders the same JSON."""
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("x").inc(1)
+    a.counter("y", node=2).inc(2)
+    b.counter("y", node=2).inc(2)
+    b.counter("x").inc(1)
+    assert a.to_json() == b.to_json()
+    json.loads(a.to_json())   # well-formed
+
+
+def test_histogram_default_bounds_are_sim_latency_shaped():
+    h = Histogram()
+    h.record(1)            # 1us
+    h.record(2_000_000)    # 2s
+    assert h.count == 2 and h.counts[0] == 1
+
+
+# ---------------------------------------------------------------------------
+# registry completeness lint (the CI satellite): new messages/phases cannot
+# ship unobserved
+# ---------------------------------------------------------------------------
+
+def test_every_message_type_has_a_metric_name():
+    enum_names = {t.name for t in MessageType}
+    missing = sorted(enum_names - set(schema.MESSAGE_METRICS))
+    assert not missing, \
+        f"MessageTypes with no metric name (add to observe/schema.py): {missing}"
+    stale = sorted(set(schema.MESSAGE_METRICS) - enum_names)
+    assert not stale, \
+        f"metric names for nonexistent MessageTypes (remove from schema): {stale}"
+
+
+def test_every_status_phase_has_a_metric_name():
+    for enum_cls, mapping, label in (
+            (Status, schema.STATUS_METRICS, "STATUS_METRICS"),
+            (SaveStatus, schema.SAVE_STATUS_METRICS, "SAVE_STATUS_METRICS")):
+        enum_names = {s.name for s in enum_cls}
+        missing = sorted(enum_names - set(mapping))
+        assert not missing, \
+            f"{enum_cls.__name__} members with no metric name " \
+            f"(add to observe/schema.py {label}): {missing}"
+        stale = sorted(set(mapping) - enum_names)
+        assert not stale, f"stale {label} entries: {stale}"
+    # outcome classes are closed over the burn's resolve kinds
+    assert set(schema.OUTCOME_METRICS) == set(schema.OUTCOMES)
+
+
+def test_metric_name_lookups_raise_actionably():
+    with pytest.raises(KeyError, match="observe/schema.py"):
+        schema.metric_for_message("BOGUS_REQ")
+    with pytest.raises(KeyError, match="observe/schema.py"):
+        schema.metric_for_save_status("BOGUS")
+
+
+# ---------------------------------------------------------------------------
+# trace ring buffer (satellite: bounded memory for long burns)
+# ---------------------------------------------------------------------------
+
+def test_trace_ring_buffer_keeps_last_n():
+    t = Trace(keep_last=100)
+    for i in range(250):
+        t.hook("DELIVER", 1, 2, i, object(), i * 10)
+    assert len(t) == 100
+    assert t.dropped == 150
+    events = list(t.events)
+    assert events[0][0] == 150 and events[-1][0] == 249   # absolute seqs
+    # unbounded mode unchanged
+    u = Trace()
+    for i in range(250):
+        u.hook("DELIVER", 1, 2, i, object(), i * 10)
+    assert len(u) == 250 and u.dropped == 0
+    # keep_last=0 means "count, keep nothing" — not unbounded
+    z = Trace(keep_last=0)
+    for i in range(7):
+        z.hook("DELIVER", 1, 2, i, object(), i)
+    assert len(z) == 0 and z.dropped == 7
+    with pytest.raises(ValueError):
+        Trace(keep_last=-1)
+
+
+def test_ring_traces_still_diff():
+    a, b = Trace(keep_last=50), Trace(keep_last=50)
+    for i in range(120):
+        a.hook("DELIVER", 1, 2, i, object(), i)
+        b.hook("DELIVER", 1, 2, i, object(), i)
+    assert diff_traces(a, b) is None
+    b.hook("DROP", 1, 2, 999, object(), 999)
+    assert diff_traces(a, b) is not None
+
+
+# ---------------------------------------------------------------------------
+# span accounting: the outcome partition
+# ---------------------------------------------------------------------------
+
+def _outcome_partition(snapshot_cluster):
+    return {o: snapshot_cluster.get(schema.OUTCOME_METRICS[o], 0)
+            for o in schema.OUTCOMES}
+
+
+def test_benign_burn_span_accounting():
+    rec = FlightRecorder()
+    result = run_burn(11, ops=30, concurrency=6, observer=rec)
+    c = rec.metrics_snapshot()["cluster"]
+    assert c[schema.SUBMITTED_METRIC] == result.ops_submitted == 30
+    partition = _outcome_partition(c)
+    assert sum(partition.values()) == 30
+    # benign network: everything acked, split fast/slow only
+    assert partition["fast"] + partition["slow"] == result.ops_ok == 30
+    assert partition["recovered"] == partition["invalidated"] == 0
+    assert c[schema.LATENCY_METRIC]["count"] == 30
+    # every client span is classified, resolved, and carries per-node
+    # per-store lifecycle transitions with sim timestamps
+    spans = rec.spans.client_spans()
+    assert len(spans) == 30
+    for span in spans:
+        assert span.path in ("fast", "slow")
+        assert span.outcome in schema.OUTCOMES
+        assert span.resolved_us is not None \
+            and span.resolved_us >= span.submitted_us
+        assert span.transitions, f"span {span.txn_id} has no transitions"
+        for (node, store), transitions in span.transitions.items():
+            statuses = [s for s, _ts in transitions]
+            assert all(s in schema.SAVE_STATUS_METRICS for s in statuses)
+            times = [ts for _s, ts in transitions]
+            assert times == sorted(times), "transitions out of sim order"
+    # per-node and per-store scopes are populated
+    snap = rec.metrics_snapshot()
+    assert any(s.startswith("node/") for s in snap)
+    assert any(s.startswith("store/") for s in snap)
+
+
+def test_span_dict_schema():
+    rec = FlightRecorder()
+    run_burn(12, ops=10, concurrency=4, observer=rec)
+    d = rec.spans.to_list()[0]
+    assert set(d) == {"txn_id", "op_id", "coordinator", "submitted_us",
+                      "resolved_us", "path", "outcome", "recoveries",
+                      "invalidate_attempts", "timeouts", "backoffs",
+                      "transitions"}
+
+
+# ---------------------------------------------------------------------------
+# the tentpole invariant: zero observer effect
+# ---------------------------------------------------------------------------
+
+def test_zero_observer_effect_hostile():
+    """Same-seed hostile burn with the flight recorder ON vs OFF: identical
+    full message traces (diff_traces is None) and identical outcomes — the
+    in-tree proof that metrics collection never perturbs the simulation."""
+    ta, tb = Trace(), Trace()
+    bare = run_burn(9, tracer=ta.hook, **HOSTILE)
+    rec = FlightRecorder()
+    observed = run_burn(9, tracer=tb.hook, observer=rec, **HOSTILE)
+    divergence = diff_traces(ta, tb)
+    assert divergence is None, \
+        f"flight recorder perturbed the simulation:\n{divergence}"
+    assert (bare.ops_ok, bare.ops_recovered, bare.ops_nacked, bare.ops_lost,
+            bare.ops_failed, bare.sim_micros) == \
+           (observed.ops_ok, observed.ops_recovered, observed.ops_nacked,
+            observed.ops_lost, observed.ops_failed, observed.sim_micros)
+    # message stats identical too (tier-choice counters are wall-clock
+    # driven and excluded from the determinism contract, as in reconcile)
+    tier_keys = ("resolver_host_consults", "resolver_native_consults",
+                 "resolver_device_consults")
+    sa = {k: v for k, v in bare.stats.items() if k not in tier_keys}
+    sb = {k: v for k, v in observed.stats.items() if k not in tier_keys}
+    assert sa == sb
+    # and the recording itself is coherent: the outcome partition covers
+    # every submitted op exactly once
+    c = rec.metrics_snapshot()["cluster"]
+    assert sum(_outcome_partition(c).values()) == c[schema.SUBMITTED_METRIC] \
+        == observed.ops_submitted
+
+
+# ---------------------------------------------------------------------------
+# Chrome-trace export
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_valid_and_counts_agree():
+    """A hostile burn's --trace-out artifact is schema-valid Chrome trace
+    JSON whose client span count equals the registry's submitted total and
+    whose outcome partition (fast+slow+recovered+invalidated+lost+failed)
+    sums to it."""
+    rec = FlightRecorder()
+    result = run_burn(13, **HOSTILE, observer=rec)
+    doc = rec.chrome_trace()
+    problems = validate_chrome_trace(doc)
+    assert problems == [], f"invalid Chrome trace: {problems[:5]}"
+    # JSON-serializable end to end
+    json.loads(json.dumps(doc))
+    c = rec.metrics_snapshot()["cluster"]
+    client_events = [e for e in doc["traceEvents"]
+                     if e.get("cat") == "txn" and e["ph"] == "X"]
+    assert len(client_events) == c[schema.SUBMITTED_METRIC] \
+        == result.ops_submitted
+    assert sum(_outcome_partition(c).values()) == result.ops_submitted
+    # lifecycle tracks exist (pid per node, tid per store), message instants
+    cats = {e.get("cat") for e in doc["traceEvents"]}
+    assert "lifecycle" in cats and "msg" in cats
+    assert any(e["ph"] == "M" and e["name"] == "process_name"
+               for e in doc["traceEvents"])
+
+
+def test_message_ring_bounds_flight_recorder():
+    rec = FlightRecorder(message_ring=500)
+    run_burn(11, ops=30, concurrency=6, observer=rec)
+    assert len(rec.messages) == 500
+    assert rec.dropped_messages > 0
+    assert validate_chrome_trace(rec.chrome_trace()) == []
+
+
+# ---------------------------------------------------------------------------
+# burn CLI: --metrics-out / --trace-out / --json enrichment / --progress
+# ---------------------------------------------------------------------------
+
+def test_burn_cli_artifacts(tmp_path, capsys):
+    from cassandra_accord_tpu.harness import burn as burn_cli
+    m, t, j = tmp_path / "m.json", tmp_path / "t.json", tmp_path / "j.json"
+    burn_cli.main(["--seeds", "1", "--ops", "20", "--no-cache-miss",
+                   "--metrics-out", str(m), "--trace-out", str(t),
+                   "--json", str(j), "--progress", "0.5"])
+    metrics = json.loads(m.read_text())
+    assert metrics["cluster"][schema.SUBMITTED_METRIC] == 20
+    trace = json.loads(t.read_text())
+    assert validate_chrome_trace(trace) == []
+    summary = json.loads(j.read_text())
+    entry = summary["results"][0]
+    assert entry["status"] == "pass"
+    # --json enrichment: the cluster-scope registry rides along per seed
+    assert entry["metrics"][schema.SUBMITTED_METRIC] == 20
+    assert sum(_outcome_partition(entry["metrics"]).values()) == 20
+    # the heartbeat printed at least one progress line
+    out = capsys.readouterr().out
+    assert "resolved=" in out and "in_flight=" in out
+
+
+def test_progress_heartbeat_lines(capsys):
+    # interval well inside the active phase: a tiny benign burn resolves all
+    # ops within a few hundred sim-ms (the later sim-time is timeout drain)
+    run_burn(11, ops=20, concurrency=4, progress_every_s=0.05,
+             progress_label="hb-test")
+    out = capsys.readouterr().out
+    lines = [l for l in out.splitlines() if l.startswith("[burn hb-test]")]
+    assert lines, "no heartbeat lines printed"
+    assert "resolved=" in lines[0] and "in_flight=" in lines[0]
+
+
+# ---------------------------------------------------------------------------
+# device-resolver counter unification
+# ---------------------------------------------------------------------------
+
+def test_resolver_counters_unified_into_registry():
+    """The same counters the burn result reports (resolver_*) land in the
+    registry under resolver.* — one source for burns and bench.py."""
+    rec = FlightRecorder()
+    result = run_burn(14, ops=20, concurrency=4, resolver="verify",
+                      observer=rec)
+    snap = rec.metrics_snapshot()
+    c = snap["cluster"]
+    for name in schema.RESOLVER_COUNTERS:
+        assert schema.RESOLVER_METRICS[name] in c, \
+            f"resolver counter {name} not collected"
+        assert c[schema.RESOLVER_METRICS[name]] == \
+            result.stats.get(f"resolver_{name}", 0)
+    # per-store scope too
+    store_scopes = [s for s in snap if s.startswith("store/")]
+    assert any(schema.RESOLVER_METRICS["walk_consults"] in snap[s]
+               for s in store_scopes)
+
+
+def test_kernel_consult_metrics_formulas():
+    from cassandra_accord_tpu.observe.device import (
+        PEAK_BF16_TFLOPS, consult_join_flops, index_bytes_int8,
+        kernel_consult_metrics)
+    assert consult_join_flops(b=2, k=3, t=5) == 60.0
+    assert index_bytes_int8(t=10, k=4) == 80
+    out = kernel_consult_metrics(t=1000, k=512, b=256, device_qps=256_000.0)
+    # 1000 launches/s x 2*256*512*1000 FLOPs = 0.262 TFLOP/s
+    assert out["device_join_tflops"] == pytest.approx(0.2621, abs=1e-3)
+    assert out["consult_mfu_vs_275tflops"] == pytest.approx(
+        out["device_join_tflops"] / PEAK_BF16_TFLOPS, abs=1e-5)
+    assert out["index_bytes_int8"] == 2 * 1000 * 512

@@ -1,0 +1,94 @@
+"""Content coverage for ``harness/watchdog.dump_wait_state``: the dump names
+every blocked txn id (up to the per-store bound), respects
+``_MAX_BLOCKED_PER_STORE``, and — with a flight recorder attached — includes
+the metrics-registry snapshot section."""
+import json
+
+from cassandra_accord_tpu.harness.cluster import Cluster, LinkConfig
+from cassandra_accord_tpu.harness.watchdog import (_MAX_BLOCKED_PER_STORE,
+                                                   dump_wait_state)
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.observe import FlightRecorder
+from cassandra_accord_tpu.primitives.keys import IntKey, Range
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+class _DropApplyTo(LinkConfig):
+    """Swallow every Apply addressed to ``victim``: its replicas never apply,
+    so later same-key txns pile up STABLE/PRE_APPLIED waiting on them."""
+
+    def __init__(self, rng, victim):
+        super().__init__(rng)
+        self.victim = victim
+
+    def action(self, from_node, to_node, message=None):
+        if to_node == self.victim and type(message).__name__ == "Apply":
+            return LinkConfig.DROP
+        return LinkConfig.DELIVER
+
+
+def _backlogged_cluster(n_txns, observer=None):
+    shards = [Shard(Range(IntKey(0), IntKey(1000)), [1, 2, 3])]
+    cluster = Cluster(Topology(1, shards), seed=6,
+                      link_config=_DropApplyTo(RandomSource(13), 3),
+                      journal=True, progress_log=False, observer=observer)
+    for i in range(n_txns):
+        r = cluster.nodes[1].coordinate(list_txn([], {IntKey(7): f"v{i}"}))
+        assert cluster.run_until(r.is_done)
+    cluster.run_until_idle()
+    blocked = [
+        (txn_id, cmd)
+        for store in cluster.nodes[3].command_stores.all_stores()
+        for txn_id, cmd in store.commands.items()
+        if cmd.waiting_on is not None and cmd.waiting_on.is_waiting()]
+    assert blocked, "fixture failed to produce blocked txns on node 3"
+    return cluster, blocked
+
+
+def test_dump_names_blocked_ids_and_their_deps():
+    cluster, blocked = _backlogged_cluster(4)
+    dump = dump_wait_state(cluster)
+    assert "BLOCKED" in dump
+    for txn_id, cmd in blocked:
+        assert str(txn_id) in dump
+        for dep in cmd.waiting_on.waiting:
+            assert str(dep) in dump
+    assert "frontier=" in dump
+
+
+def test_dump_respects_max_blocked_per_store_bound():
+    """More blocked txns than the bound: exactly _MAX_BLOCKED_PER_STORE
+    BLOCKED lines for that store (oldest first) plus a '... N more' line
+    accounting for the rest."""
+    n = _MAX_BLOCKED_PER_STORE + 6
+    cluster, blocked = _backlogged_cluster(n + 1)   # txn 1 is the unblocked root
+    assert len(blocked) > _MAX_BLOCKED_PER_STORE
+    dump = dump_wait_state(cluster)
+    blocked_lines = [l for l in dump.splitlines()
+                     if l.lstrip().startswith("BLOCKED")]
+    assert len(blocked_lines) == _MAX_BLOCKED_PER_STORE
+    overflow = len(blocked) - _MAX_BLOCKED_PER_STORE
+    assert f"... {overflow} more blocked txns" in dump
+    # the listed ids are the OLDEST blocked (the stall root end of the graph)
+    oldest = sorted(txn_id for txn_id, _cmd in blocked)[:_MAX_BLOCKED_PER_STORE]
+    for txn_id in oldest:
+        assert str(txn_id) in dump
+
+
+def test_dump_includes_metrics_snapshot_with_flight_recorder():
+    rec = FlightRecorder()
+    cluster, blocked = _backlogged_cluster(4, observer=rec)
+    dump = dump_wait_state(cluster)
+    metrics_lines = [l for l in dump.splitlines() if l.startswith("metrics: ")]
+    assert len(metrics_lines) == 1, "metrics snapshot section missing"
+    snap = json.loads(metrics_lines[0][len("metrics: "):])
+    # the registry really rode along: lifecycle counters + pulled store gauges
+    assert snap["cluster"]["txn.save_status.pre_accepted"] >= 4
+    assert any(scope.startswith("store/") for scope in snap)
+
+
+def test_dump_has_no_metrics_section_without_recorder():
+    cluster, _blocked = _backlogged_cluster(3)
+    dump = dump_wait_state(cluster)
+    assert "metrics: " not in dump
