@@ -12,6 +12,10 @@ behind the same protocol code (impl/resolver.py boundary):
                  window's PreAccept/Accept consults are answered by ONE fused
                  MXU launch (ops.deps_kernels.consult).
 
+Output: the full-detail RESULT object, then — as the LAST stdout line — a
+compact single-line JSON summary (metric/value/unit/vs_baseline + per-stage
+health) sized to survive the harness's bounded tail capture.
+
 ``vs_baseline`` is tpu/cpu on identical seed+workload — an honest end-to-end
 comparison, not a strawman.  NOTE the cpu baseline here is this repo's Python
 host walk, not the reference JVM (stated per VERDICT r02 task #2).
@@ -332,6 +336,23 @@ def emit_and_exit(code=0):
     _EMITTED = True
     _finalize_headline()
     print(json.dumps(RESULT), flush=True)
+    # the harness captures only a bounded TAIL of stdout and parses its last
+    # line: the full-detail object above routinely exceeds that window and
+    # parsed as null in every BENCH_r0*.json — so the LAST line is a compact
+    # single-line summary that always fits (headline + stage health only)
+    summary = {
+        "metric": RESULT["metric"],
+        "value": RESULT["value"],
+        "unit": RESULT["unit"],
+        "vs_baseline": RESULT["vs_baseline"],
+        "incomplete": RESULT["detail"].get("incomplete", True),
+        "headline_tier": RESULT["detail"].get("headline_tier"),
+        "device_present": RESULT["detail"].get("device_present"),
+        "stages": {name: ("error" if "error" in st
+                          else "skipped" if "skipped" in st else "ok")
+                   for name, st in RESULT["detail"].get("stages", {}).items()},
+    }
+    print(json.dumps(summary), flush=True)
     os._exit(code)
 
 

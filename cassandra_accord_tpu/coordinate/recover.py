@@ -57,8 +57,10 @@ def recover(node: "Node", txn_id: TxnId, txn: Txn, route: Route,
     observer = getattr(node, "observer", None)
     if observer is not None:
         # recovery attribution: the txn's span records who tried to recover
-        # it and how often (the flight recorder's recovery.* counters)
-        observer.on_recovery(node.id, txn_id, ballot)
+        # it and how often (the flight recorder's recovery.* counters); the
+        # sim timestamp feeds the trace export's recovery counter track and
+        # closes the auditor's unattended-SLO flag
+        observer.on_recovery(node.id, txn_id, ballot, node.now_micros())
     _Recover(node, ballot, txn_id, txn, route, result).start()
 
 
@@ -432,7 +434,7 @@ def invalidate(node: "Node", txn_id: TxnId, route: Route, result: au.Settable,
     observer = getattr(node, "observer", None)
     if observer is not None:
         # invalidation attribution for the txn's flight-recorder span
-        observer.on_invalidate(node.id, txn_id)
+        observer.on_invalidate(node.id, txn_id, node.now_micros())
     topologies = node.topology.precise_epochs(route, txn_id.epoch, txn_id.epoch)
     topology = node.topology.topology_for_epoch(txn_id.epoch)
     shard = topology.for_key_required(route.home_key)

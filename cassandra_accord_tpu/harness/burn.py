@@ -45,6 +45,7 @@ class BurnResult:
         self.disk_stalls = 0     # journal-append stalls
         self.sim_micros = 0
         self.stats: Dict[str, int] = {}
+        self.audit: Optional[dict] = None   # InvariantAuditor verdict, if on
 
     @property
     def resolved(self) -> int:
@@ -69,6 +70,7 @@ class SimulationException(Exception):
         super().__init__(f"burn seed={seed} failed: {cause}")
         self.seed = seed
         self.cause = cause
+        self.audit = None   # InvariantAuditor verdict at failure, if audited
 
 
 MAX_PROBE_ATTEMPTS = 1000   # ListRequest.java:204 "arbitrarily large limit"
@@ -141,6 +143,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              max_tasks: int = 20_000_000,
              tracer=None, on_submit=None, consult_recorder=None,
              observer=None,
+             audit: str = "off",
+             audit_slo_s: Optional[float] = None,
              progress_every_s: Optional[float] = None,
              progress_label: str = "") -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation.
@@ -175,8 +179,31 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     ``progress_every_s``: heartbeat — print one progress line (ops resolved,
     in-flight, fast-path share) per this many SIM-seconds, so long seed
     sweeps aren't silent until the watchdog fires.
+
+    ``audit``: ``"strict"`` / ``"warn"`` / ``"off"`` — run the online
+    protocol-invariant auditor (observe/audit.py) over the same hooks the
+    flight recorder uses.  ``strict`` raises AuditViolation (wrapped in
+    SimulationException) at the first violated invariant; ``warn`` records
+    violations into ``result.audit``.  Either way ``result.audit`` carries
+    the per-run verdict (violations, SLO flags).  ``audit_slo_s`` overrides
+    the unattended-txn liveness budget (sim-seconds).  The auditor IS a
+    FlightRecorder, so ``observer`` must be left None (one is created) or
+    already be an InvariantAuditor.
     """
     from ..config import LocalConfig
+    if audit not in ("off", "strict", "warn"):
+        raise ValueError(f"audit must be off/strict/warn, got {audit!r}")
+    if audit != "off":
+        from ..observe.audit import InvariantAuditor
+        if observer is None:
+            observer = InvariantAuditor(mode=audit,
+                                        slo_unattended_s=audit_slo_s)
+        elif isinstance(observer, InvariantAuditor):
+            observer.mode = audit
+        else:
+            raise ValueError("audit requires the observer to be an "
+                             "InvariantAuditor (or None — one is created); "
+                             "got a plain FlightRecorder")
     rng = RandomSource(seed)
     rf = rf if rf is not None else rng.pick([3, 3, 5])
     n_nodes = nodes if nodes is not None else rng.next_int(rf, 2 * rf)
@@ -679,6 +706,14 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             # end-of-run pull collection: simulator stats, per-store gauges,
             # resolver counters — one registry for burns AND bench reporting
             observer.collect_cluster(cluster)
+            verdict = getattr(observer, "verdict", None)
+            if verdict is not None:
+                result.audit = verdict()
+            if audit == "strict" and getattr(observer, "violations", None):
+                # belt-and-braces: a violation raised inside a callback can
+                # be swallowed by on_callback_failure plumbing — a strict
+                # run must STILL fail on any recorded violation
+                raise observer.violations[0]
         if result.resolved < ops:
             raise HistoryViolation(
                 f"only {result.resolved}/{ops} ops resolved (liveness stall): "
@@ -723,17 +758,24 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             # failure path carry the final simulator/store state too
             try:
                 observer.collect_cluster(cluster)
+                verdict = getattr(observer, "verdict", None)
+                if verdict is not None:
+                    result.audit = verdict()
             except Exception:  # noqa: BLE001 — never mask the real failure
                 pass
-        raise SimulationException(seed, e) from e
+        wrapped = SimulationException(seed, e)
+        wrapped.audit = result.audit   # the verdict survives the failure path
+        raise wrapped from e
     return result
 
 
-def reconcile(seed: int, **kwargs) -> None:
+def reconcile(seed: int, **kwargs):
     """Run the same seed twice and assert identical observable behavior —
     the COMPLETE message traces (every SEND/DROP/RPLY/RECV with its logical
     sequence number), plus outcome counters and message stats.  Catches
-    nondeterminism itself (BurnTest.reconcile, ReconcilingLogger)."""
+    nondeterminism itself (BurnTest.reconcile, ReconcilingLogger).  Returns
+    the two BurnResults (with ``audit=...`` each run constructs its own
+    auditor; the caller reads the verdicts off the results)."""
     from .trace import Trace, diff_traces
     ta, tb = Trace(), Trace()
     a = run_burn(seed, tracer=ta.hook, **kwargs)
@@ -755,6 +797,7 @@ def reconcile(seed: int, **kwargs) -> None:
     assert sa == sb, \
         f"nondeterministic message counts for seed {seed}: " \
         f"{ {k: (sa.get(k), sb.get(k)) for k in set(sa) | set(sb) if sa.get(k) != sb.get(k)} }"
+    return a, b
 
 
 def main(argv=None) -> None:
@@ -802,6 +845,18 @@ def main(argv=None) -> None:
     p.add_argument("--restart-interval", type=float, default=None,
                    help="mean sim-seconds between crash attempts "
                         "(default: LocalConfig/ACCORD_RESTART_INTERVAL)")
+    p.add_argument("--audit", default="off",
+                   choices=["strict", "warn", "off"],
+                   help="online protocol-invariant auditor over the flight-"
+                        "recorder stream (observe/audit.py): strict raises "
+                        "at the first violated invariant with the txn's "
+                        "full timeline; warn records violations into the "
+                        "--json verdict; SLO liveness flags are recorded "
+                        "either way")
+    p.add_argument("--audit-slo", type=float, default=None, metavar="SIM_S",
+                   help="auditor liveness budget: flag a txn undecided this "
+                        "many sim-seconds with no recovery attempt "
+                        "attributed (default 10)")
     p.add_argument("--json", default=None, metavar="PATH",
                    help="write a machine-readable per-seed summary "
                         "(pass/stall/divergence, wall-clock, ops resolved, "
@@ -893,7 +948,21 @@ def main(argv=None) -> None:
                   node_config=cfg,
                   max_tasks=200_000_000)
         observer = None
-        if (args.metrics_out or args.trace_out) and not args.reconcile:
+        if args.audit != "off" and not args.reconcile:
+            # the auditor IS a FlightRecorder, so it also serves
+            # --metrics-out/--trace-out (reconcile runs construct their own
+            # auditor per run inside run_burn — audit composes with
+            # --reconcile, artifacts do not)
+            from ..observe import InvariantAuditor
+            observer = InvariantAuditor(
+                mode=args.audit, slo_unattended_s=args.audit_slo,
+                record_messages=bool(args.trace_out))
+            kw["observer"] = observer
+            kw["audit"] = args.audit
+        elif args.audit != "off" and args.reconcile:
+            kw["audit"] = args.audit
+            kw["audit_slo_s"] = args.audit_slo
+        elif (args.metrics_out or args.trace_out) and not args.reconcile:
             # flight recorder (reconcile runs its own two bare runs: the
             # recorder would conflate them, so it stays off there — warned
             # once before the loop)
@@ -920,9 +989,13 @@ def main(argv=None) -> None:
         summaries.append(entry)
         try:
             if args.reconcile:
-                reconcile(seed, **kw)
+                ra, _rb = reconcile(seed, **kw)
                 entry.update(status="pass", reconciled=True,
                              wall_s=round(_time.perf_counter() - t0, 3))
+                if getattr(ra, "audit", None) is not None:
+                    # warn-mode verdicts must not be silently dropped: the
+                    # runs are trace-identical, so one verdict speaks for both
+                    entry["audit"] = ra.audit
                 write_json()
                 print(f"seed {seed}: reconciled (rf={rf}, "
                       f"{_time.perf_counter() - t0:.1f}s)")
@@ -941,12 +1014,18 @@ def main(argv=None) -> None:
                     # partition, path split, recovery/timeout counters)
                     entry["metrics"] = \
                         observer.metrics_snapshot().get("cluster", {})
+                if getattr(result, "audit", None) is not None:
+                    # per-seed audit verdict: violations + SLO flags
+                    entry["audit"] = result.audit
                 write_artifacts()
                 write_json()
                 print(f"seed {seed}: {result!r} (rf={rf}, "
                       f"{_time.perf_counter() - t0:.1f}s)")
         except SimulationException as e:
-            if isinstance(e.cause, StallError):
+            from ..observe.audit import AuditViolation
+            if isinstance(e.cause, AuditViolation):
+                status = "audit_violation"
+            elif isinstance(e.cause, StallError):
                 status = "stall"
             elif isinstance(e.cause, HistoryViolation) \
                     and "divergence" in str(e.cause):
@@ -956,6 +1035,8 @@ def main(argv=None) -> None:
             entry.update(status=status,
                          wall_s=round(_time.perf_counter() - t0, 3),
                          error=str(e.cause)[:2000])
+            if e.audit is not None:
+                entry["audit"] = e.audit
             # the flight recording is MOST valuable on a failed seed: write
             # whatever was captured up to the failure point
             write_artifacts()

@@ -630,6 +630,10 @@ class Cluster:
         # fed from the same sites as the tracer plus the lifecycle planes;
         # MUST have zero observer effect (no RNG, no wall clock, no scheduling)
         self.observer = observer
+        if observer is not None and hasattr(observer, "attach_cluster"):
+            # the InvariantAuditor reads cluster state (node epochs, the
+            # epoch-sync ledger) passively for its monotonicity rules
+            observer.attach_cluster(self)
         # controllable-delivery hook (MockCluster/Network capability,
         # impl/mock/MockCluster.java): fn(from, to, request, msg_id,
         # has_callback) -> True to swallow (the hook owns delivery/reply)
@@ -902,6 +906,11 @@ class Cluster:
         # purge the request-coalescing inbox (those messages were in RAM)
         self._inboxes.pop(node_id, None)
         self._inbox_drain_at.pop(node_id, None)
+        if self.observer is not None:
+            # the auditor re-baselines the node's lifecycle state here: the
+            # journal replay at restart legitimately re-observes commands at
+            # their durable tier, below whatever the volatile state reached
+            self.observer.on_crash(node_id)
         self._count("node_crashes")
 
     def restart(self, node_id: int, lose_tail: int = 0) -> Node:
@@ -1023,6 +1032,10 @@ class Cluster:
             self.queue.add_after(1, relaunch)
         for hook in list(self.on_restart_hooks):
             hook(node)
+        if self.observer is not None:
+            # replay is complete: the auditor resumes normal edge checking
+            # for this node (post-restart traffic takes live paths again)
+            self.observer.on_restart(node_id)
         self._count("node_restarts")
         return node
 

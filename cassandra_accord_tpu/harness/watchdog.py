@@ -93,6 +93,15 @@ def dump_wait_state(cluster: Cluster) -> str:
             lines.append("metrics: " + observer.registry_json(cluster))
         except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
             lines.append(f"metrics: <error {e!r}>")
+        # audit section (InvariantAuditor): the open liveness-SLO flags name
+        # the exact txns a stall is stuck on — read this BEFORE the wait
+        # graph; the flagged ids are usually the roots
+        report = getattr(observer, "audit_report", None)
+        if report is not None:
+            try:
+                lines.append("audit: " + report())
+            except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
+                lines.append(f"audit: <error {e!r}>")
     return "\n".join(lines)
 
 

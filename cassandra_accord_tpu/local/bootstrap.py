@@ -45,7 +45,12 @@ class Bootstrap:
         that then needs recovery/invalidation) — the hostile matrix went
         superlinear on exactly this."""
         self.attempts += 1
-        return min(0.5 * (2.0 ** (self.attempts - 1)), 8.0)
+        # exponent capped BEFORE exponentiation: 2.0**1024 raises
+        # OverflowError, and a long-starved bootstrap (a quorumless range
+        # retrying through a whole hostile run) gets past 1024 attempts —
+        # values are identical below the cap (2**5 already saturates the 8s
+        # ceiling)
+        return min(0.5 * (2.0 ** min(self.attempts - 1, 8)), 8.0)
 
     def start(self) -> au.AsyncResult:
         self.store.pending_bootstrap = self.store.pending_bootstrap.union(self.ranges)

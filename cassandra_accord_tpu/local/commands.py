@@ -39,13 +39,17 @@ def _observe_transition(safe_store: SafeCommandStore, command: Command) -> None:
     """Report a just-applied SaveStatus transition to the run's flight
     recorder (observe.FlightRecorder) — the per-node/per-store txn lifecycle
     span plane.  Passive by contract: reads sim time, touches no RNG and
-    schedules nothing (zero observer effect)."""
+    schedules nothing (zero observer effect).  The live ``command`` and
+    ``CommandStore`` ride along so the InvariantAuditor can read decision
+    state (executeAt, deps, ballots, watermarks) at the transition — reads
+    only; the recorder base class ignores them."""
     store = safe_store.store
     obs = store.observer()
     if obs is not None:
         obs.on_transition(store.node.id, store.id, command.txn_id,
                           command.save_status.name,
-                          safe_store.time().now_micros())
+                          safe_store.time().now_micros(),
+                          command=command, command_store=store)
 
 
 # ---------------------------------------------------------------------------

@@ -17,10 +17,12 @@ and identical final-state outcome counters.
 from .registry import Counter, Gauge, Histogram, MetricsRegistry
 from .spans import TxnSpan, TxnSpanRecorder
 from .flight import FlightRecorder
+from .audit import AuditViolation, InvariantAuditor
 from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "TxnSpan", "TxnSpanRecorder", "FlightRecorder",
+    "AuditViolation", "InvariantAuditor",
     "chrome_trace", "validate_chrome_trace", "write_chrome_trace",
 ]

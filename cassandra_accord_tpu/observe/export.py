@@ -12,7 +12,14 @@ simulator's native unit):
   lifecycle (PRE_ACCEPTED until ACCEPTED, ... until the next transition),
   with an ``i`` (instant) event for the terminal status;
 - optional ``i`` events for raw message routing (SEND/DROP/RECV...), on the
-  sending node's coordinator track.
+  sending node's coordinator track;
+- ``C`` (counter) events on the synthetic counters process (pid 0): in-flight
+  client txns and cumulative recovery / invalidation attempts, sampled on
+  uniform sim-time buckets — Perfetto renders them as counter tracks above
+  the spans, so livelock shapes (the seed-6 probe storm) are visible at a
+  glance.  Derived at EXPORT time from the recorded spans and attempt
+  timestamps: no runtime sampling task, so the zero-observer-effect contract
+  is untouched.
 
 ``validate_chrome_trace`` is the schema check the tier-1 tests run over
 every export.
@@ -22,7 +29,54 @@ from __future__ import annotations
 import json
 from typing import List
 
-_VALID_PHASES = {"X", "i", "M", "B", "E"}
+_VALID_PHASES = {"X", "i", "M", "B", "E", "C"}
+
+# synthetic pid for cluster-wide counter tracks (real nodes are 1-based)
+COUNTER_PID = 0
+_COUNTER_BUCKETS = 256
+
+
+def counter_events(recorder, buckets: int = _COUNTER_BUCKETS) -> List[dict]:
+    """Cluster-wide counter tracks sampled on uniform sim-time buckets:
+    in-flight client txns (from span submit/resolve envelopes) and
+    cumulative recovery / invalidation attempts (from the recorder's
+    sim-timestamped attribution)."""
+    spans = [s for s in recorder.spans.spans.values() if s.is_client_op]
+    times = [s.submitted_us for s in spans] \
+        + [s.resolved_us for s in spans if s.resolved_us is not None] \
+        + list(recorder._recovery_times) + list(recorder._invalidate_times)
+    if not times:
+        return []
+    lo, hi = min(times), max(times)
+    width = max((hi - lo) // max(buckets, 1), 1)
+    edges = list(range(lo, hi + width, width))
+
+    def cumulative(points):
+        pts = sorted(points)
+        out, i = [], 0
+        for edge in edges:
+            while i < len(pts) and pts[i] <= edge:
+                i += 1
+            out.append(i)
+        return out
+
+    submitted = cumulative([s.submitted_us for s in spans])
+    resolved = cumulative([s.resolved_us for s in spans
+                           if s.resolved_us is not None])
+    recoveries = cumulative(recorder._recovery_times)
+    invalidates = cumulative(recorder._invalidate_times)
+    events: List[dict] = []
+    for i, edge in enumerate(edges):
+        events.append({"name": "in_flight_txns", "cat": "counter", "ph": "C",
+                       "ts": edge, "pid": COUNTER_PID, "tid": 0,
+                       "args": {"in_flight": submitted[i] - resolved[i]}})
+        if recoveries[-1] or invalidates[-1]:
+            events.append({"name": "recovery_attempts", "cat": "counter",
+                           "ph": "C", "ts": edge, "pid": COUNTER_PID,
+                           "tid": 0,
+                           "args": {"recoveries": recoveries[i],
+                                    "invalidations": invalidates[i]}})
+    return events
 
 
 def _span_events(span) -> List[dict]:
@@ -67,6 +121,11 @@ def chrome_trace(recorder, include_messages: bool = True) -> dict:
             pids.add(ev["pid"])
             tids.add((ev["pid"], ev["tid"]))
             events.append(ev)
+    counters = counter_events(recorder)
+    if counters:
+        pids.add(COUNTER_PID)
+        tids.add((COUNTER_PID, 0))
+        events.extend(counters)
     if include_messages:
         for seq, ts, event, frm, to, msg_id, brief in recorder.messages:
             pids.add(frm)
@@ -78,10 +137,14 @@ def chrome_trace(recorder, include_messages: bool = True) -> dict:
                                     "msg_id": msg_id}})
     meta: List[dict] = []
     for pid in sorted(pids):
+        pname = "cluster counters" if pid == COUNTER_PID else f"node {pid}"
         meta.append({"name": "process_name", "ph": "M", "ts": 0, "pid": pid,
-                     "tid": 0, "args": {"name": f"node {pid}"}})
+                     "tid": 0, "args": {"name": pname}})
     for pid, tid in sorted(tids):
-        name = "coordinator" if tid == 0 else f"store {tid - 1}"
+        if pid == COUNTER_PID:
+            name = "counters"
+        else:
+            name = "coordinator" if tid == 0 else f"store {tid - 1}"
         meta.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
                      "tid": tid, "args": {"name": name}})
     events.sort(key=lambda e: (e["ts"], e["pid"], e["tid"], e["name"]))
@@ -126,6 +189,13 @@ def validate_chrome_trace(doc) -> List[str]:
             dur = ev.get("dur")
             if not isinstance(dur, int) or dur <= 0:
                 problems.append(f"{ctx}: X event needs a positive int dur")
+        if ph == "C":
+            args = ev.get("args")
+            if not isinstance(args, dict) or not args:
+                problems.append(f"{ctx}: C event needs a non-empty args dict")
+            elif not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                         for v in args.values()):
+                problems.append(f"{ctx}: C event args must be numeric series")
         if "args" in ev:
             try:
                 json.dumps(ev["args"])

@@ -45,6 +45,10 @@ class FlightRecorder:
         # the message timeline IS a Trace (same event tuples, same optional
         # ring bound) — one ring-buffer implementation, not two
         self._message_trace = Trace(keep_last=message_ring)
+        # sim-timestamped recovery/invalidation attempts (Chrome-trace
+        # counter tracks sample these into per-bucket "C" events)
+        self._recovery_times: list = []
+        self._invalidate_times: list = []
 
     @property
     def messages(self):
@@ -109,23 +113,43 @@ class FlightRecorder:
             self.registry.counter("txn.fastpath.votes_accept").inc(accepts)
             self.registry.counter("txn.fastpath.votes_reject").inc(rejects)
 
-    def on_recovery(self, node: int, txn_id, ballot=None) -> None:
+    def on_recovery(self, node: int, txn_id, ballot=None, now_us=None) -> None:
         self.spans.on_recovery(txn_id)
         self.registry.counter("recovery.attempts").inc()
         self.registry.counter("recovery.attempts", node=node).inc()
+        if now_us is not None:
+            # sim-timestamped attribution: the Chrome-trace export's
+            # recovery counter track samples these
+            self._recovery_times.append(now_us)
 
-    def on_invalidate(self, node: int, txn_id) -> None:
+    def on_invalidate(self, node: int, txn_id, now_us=None) -> None:
         self.spans.on_invalidate_attempt(txn_id)
         self.registry.counter("recovery.invalidate_attempts").inc()
         self.registry.counter("recovery.invalidate_attempts", node=node).inc()
+        if now_us is not None:
+            self._invalidate_times.append(now_us)
 
     # -- replica-side lifecycle (local/commands.py) --------------------------
     def on_transition(self, node: int, store: int, txn_id,
-                      status_name: str, now_us: int) -> None:
+                      status_name: str, now_us: int,
+                      command=None, command_store=None) -> None:
+        """``command``/``command_store`` are the live objects the transition
+        just mutated — passed so the InvariantAuditor subclass can read
+        decision state (executeAt, deps, ballots, watermarks) passively;
+        the recorder itself only uses the scalar fields."""
         self.spans.on_transition(node, store, txn_id, status_name, now_us)
         name = schema.metric_for_save_status(status_name)
         self.registry.counter(name).inc()
         self.registry.counter(name, node=node, store=store).inc()
+
+    # -- node lifecycle (harness/cluster.py crash/restart) -------------------
+    def on_crash(self, node_id: int) -> None:
+        self.registry.counter("lifecycle.node_crashes").inc()
+        self.registry.counter("lifecycle.node_crashes", node=node_id).inc()
+
+    def on_restart(self, node_id: int) -> None:
+        self.registry.counter("lifecycle.node_restarts").inc()
+        self.registry.counter("lifecycle.node_restarts", node=node_id).inc()
 
     # -- progress-log liveness machinery (local/progress_log.py) -------------
     def on_progress(self, kind: str, node: int,

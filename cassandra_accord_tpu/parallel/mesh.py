@@ -39,6 +39,19 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+# jax.shard_map graduated from jax.experimental in 0.4.4x; the pinned
+# toolchain (0.4.37) still exports it only from the experimental module
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _experimental_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        # the experimental signature spells the replication check "check_rep"
+        return _experimental_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                       out_specs=out_specs,
+                                       check_rep=check_vma)
+
 from ..ops import graph_state as gs
 from ..ops import deps_kernels as dk
 from ..models.conflict_graph import TxnBatch
@@ -155,7 +168,7 @@ def build_sharded_step(mesh: Mesh):
         applied = jax.lax.all_gather(ready, SHARD, tiled=True)   # [T]
         return state, conflict_max, applied
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_step, mesh=mesh,
         in_specs=(state_specs(), batch_specs()),
         out_specs=(state_specs(), P(), P()),
@@ -192,7 +205,7 @@ def build_sharded_store_consult(mesh: Mesh):
 
     spec3 = P(SHARD, None, None)
     spec2 = P(SHARD, None)
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh,
         in_specs=(spec3, spec3, spec3, spec3, spec2, spec2, spec2,
                   spec3, spec3, spec2),
@@ -209,7 +222,7 @@ def build_sharded_frontier(mesh: Mesh):
     def local(adj, status, active):
         return jax.vmap(dk.kahn_frontier)(adj, status, active)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh,
         in_specs=(P(SHARD, None, None), P(SHARD, None), P(SHARD, None)),
         out_specs=P(SHARD, None),
@@ -235,7 +248,7 @@ def build_sharded_closure(mesh: Mesh):
 
         return jax.lax.fori_loop(0, iters, body, adj_local.astype(jnp.bool_))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local_closure, mesh=mesh,
         in_specs=(P(SHARD, None),), out_specs=P(SHARD, None),
         check_vma=False)

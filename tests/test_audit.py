@@ -1,0 +1,412 @@
+"""The online protocol-invariant auditor (observe/audit.py + rules.py).
+
+Contracts proven here:
+
+1. ZERO OBSERVER EFFECT extends to the auditor: a same-seed hostile burn
+   with ``audit="strict"`` vs no observer yields byte-identical message
+   traces and identical outcomes.
+2. MUTATION CHECK: deliberately-injected violations — an illegal SaveStatus
+   edge, a deps mismatch between two replicas' same-ballot commits, a ballot
+   regression — are each caught AT THE INJECTING EVENT.
+3. LEGAL-EDGE LINT: the edge table agrees two-way with the SaveStatus enum
+   (every member a source and a target of at least one legal edge).
+4. The strict matrix smoke: benign and hostile burns run clean under
+   ``--audit=strict`` (zero violations), and the CLI carries per-seed audit
+   verdicts in ``--json``.
+"""
+import json
+
+import pytest
+
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+from cassandra_accord_tpu.local.command import Command
+from cassandra_accord_tpu.local.durability import DurableBefore, RedundantBefore
+from cassandra_accord_tpu.observe import AuditViolation, InvariantAuditor
+from cassandra_accord_tpu.observe import rules
+from cassandra_accord_tpu.primitives.deps import Deps, KeyDeps
+from cassandra_accord_tpu.primitives.keys import IntKey, Range, Ranges
+from cassandra_accord_tpu.primitives.timestamp import (Ballot, Domain,
+                                                       Timestamp, TxnId,
+                                                       TxnKind)
+
+HOSTILE = dict(ops=40, concurrency=8, chaos=True, allow_failures=True,
+               durability=True, journal=True, delayed_stores=True,
+               clock_drift=True, max_tasks=3_000_000)
+
+
+def tid(hlc: int, node: int = 1, kind=TxnKind.WRITE) -> TxnId:
+    return TxnId(epoch=1, hlc=hlc, node=node, kind=kind, domain=Domain.KEY)
+
+
+class _FakeNode:
+    def __init__(self, node_id):
+        self.id = node_id
+
+
+class _FakeStore:
+    """The slice of CommandStore the auditor reads (reads only)."""
+
+    def __init__(self, node_id: int, store_id: int, ranges: Ranges):
+        self.node = _FakeNode(node_id)
+        self.id = store_id
+        self._ranges = ranges
+        self.commands = {}
+        self.cold = set()
+        self.tfk_inversions = 0
+        self.durable_gen = 0
+        self.redundant_before = RedundantBefore.EMPTY
+        self.durable_before = DurableBefore.EMPTY
+
+    def all_ranges(self):
+        return self._ranges
+
+    def ranges_at(self, _epoch):
+        return self._ranges
+
+
+# ---------------------------------------------------------------------------
+# legal-edge table lint (the CI satellite)
+# ---------------------------------------------------------------------------
+
+def test_legal_edge_table_lints_two_way():
+    assert rules.lint_legal_edges() == []
+
+
+def test_legal_edge_lint_catches_gaps(monkeypatch):
+    # removing a source row OR a member's only target edge must be caught
+    broken = {k: v for k, v in rules.LEGAL_EDGES.items() if k != "APPLYING"}
+    monkeypatch.setattr(rules, "LEGAL_EDGES", broken)
+    problems = rules.lint_legal_edges()
+    assert any("APPLYING" in p and "source" in p for p in problems)
+    broken2 = dict(rules.LEGAL_EDGES)
+    broken2["PRE_APPLIED"] = frozenset({"TRUNCATED_APPLY", "ERASED"})
+    monkeypatch.setattr(rules, "LEGAL_EDGES", broken2)
+    problems = rules.lint_legal_edges()
+    assert any("APPLYING" in p and "target" in p for p in problems)
+
+
+def test_edge_predicate():
+    assert rules.is_legal_edge("NOT_DEFINED", "PRE_ACCEPTED")
+    assert rules.is_legal_edge("STABLE", "READY_TO_EXECUTE")
+    assert not rules.is_legal_edge("APPLIED", "PRE_ACCEPTED")
+    assert not rules.is_legal_edge("INVALIDATED", "COMMITTED")
+
+
+# ---------------------------------------------------------------------------
+# mutation checks: injected violations caught at the injecting event
+# ---------------------------------------------------------------------------
+
+def test_mutation_illegal_edge_raises_at_event():
+    auditor = InvariantAuditor(mode="strict")
+    t = tid(100)
+    auditor.on_transition(1, 0, t, "STABLE", 10)
+    auditor.on_transition(1, 0, t, "READY_TO_EXECUTE", 20)
+    with pytest.raises(AuditViolation) as exc:
+        # regression to an earlier phase: never legal
+        auditor.on_transition(1, 0, t, "PRE_ACCEPTED", 30)
+    v = exc.value
+    assert v.rule == rules.RULE_ILLEGAL_EDGE
+    assert "READY_TO_EXECUTE -> PRE_ACCEPTED" in v.detail
+    assert v.node == 1 and v.store == 0 and v.now_us == 30
+    # the violation carries the txn's full flight-recorder timeline
+    assert v.timeline is not None
+    assert v.timeline["transitions"]["1/0"] == [
+        ["STABLE", 10], ["READY_TO_EXECUTE", 20], ["PRE_ACCEPTED", 30]] or \
+        v.timeline["transitions"]["1/0"] == [
+        ("STABLE", 10), ("READY_TO_EXECUTE", 20), ("PRE_ACCEPTED", 30)]
+    assert v.registry is not None
+    # warn mode records instead of raising
+    warn = InvariantAuditor(mode="warn")
+    warn.on_transition(1, 0, t, "PRE_APPLIED", 10)
+    warn.on_transition(1, 0, t, "PRE_ACCEPTED", 20)
+    assert len(warn.violations) == 1
+    assert warn.verdict()["violations"] == 1
+    assert warn.verdict()["rules_violated"] == [rules.RULE_ILLEGAL_EDGE]
+
+
+def test_mutation_deps_mismatch_between_replica_commits():
+    """Two replicas commit the same txn at the same ballot with different
+    deps over commonly-owned ranges, the differing dep live: caught at the
+    second replica's commit event."""
+    ranges = Ranges.of(Range(IntKey(0), IntKey(100)))
+    store_a = _FakeStore(1, 0, ranges)
+    store_b = _FakeStore(2, 0, ranges)
+    t = tid(500)
+    rk = IntKey(10).to_routing()
+    dep_live = tid(400, node=2)
+    deps_a = Deps(key_deps=KeyDeps.of({rk: [dep_live]}))
+    deps_b = Deps(key_deps=KeyDeps.of({rk: []}))
+
+    def committed(store, deps):
+        cmd = Command(t)
+        cmd.execute_at = Timestamp(1, 600, 1)
+        cmd.partial_deps = deps
+        cmd.accepted_or_committed = Ballot.ZERO
+        return cmd
+
+    auditor = InvariantAuditor(mode="strict")
+    auditor.on_transition(1, 0, t, "COMMITTED", 10,
+                          command=committed(store_a, deps_a),
+                          command_store=store_a)
+    with pytest.raises(AuditViolation) as exc:
+        auditor.on_transition(2, 0, t, "COMMITTED", 20,
+                              command=committed(store_b, deps_b),
+                              command_store=store_b)
+    v = exc.value
+    assert v.rule == rules.RULE_DEPS_MISMATCH
+    assert str(dep_live) in v.detail
+    assert v.now_us == 20
+
+
+def test_deps_difference_of_settled_entries_is_elision_legal():
+    """The SAME mismatch is legal when the differing dep is settled (applied)
+    at the store that lacks it — the universal-durability elision class."""
+    from cassandra_accord_tpu.local.status import SaveStatus
+    ranges = Ranges.of(Range(IntKey(0), IntKey(100)))
+    store_a = _FakeStore(1, 0, ranges)
+    store_b = _FakeStore(2, 0, ranges)
+    t = tid(500)
+    rk = IntKey(10).to_routing()
+    dep = tid(400, node=2)
+    # the lacking store (b) has the dep APPLIED: eliding it cannot reorder
+    settled = Command(dep)
+    settled.save_status = SaveStatus.APPLIED
+    store_b.commands[dep] = settled
+    deps_a = Deps(key_deps=KeyDeps.of({rk: [dep]}))
+    deps_b = Deps(key_deps=KeyDeps.of({rk: []}))
+    auditor = InvariantAuditor(mode="strict")
+    for node, store, deps in ((1, store_a, deps_a), (2, store_b, deps_b)):
+        cmd = Command(t)
+        cmd.execute_at = Timestamp(1, 600, 1)
+        cmd.partial_deps = deps
+        cmd.accepted_or_committed = Ballot.ZERO
+        auditor.on_transition(node, 0, t, "COMMITTED", 10, command=cmd,
+                              command_store=store)
+    assert auditor.violations == []
+    assert auditor.registry.counter("audit.deps_elision_diffs").value == 1
+
+
+def test_mutation_ballot_regression():
+    auditor = InvariantAuditor(mode="strict")
+    t = tid(700)
+    store = _FakeStore(3, 0, Ranges.of(Range(IntKey(0), IntKey(100))))
+    cmd = Command(t)
+    cmd.promised = Ballot(1, 50, 3)
+    auditor.on_transition(3, 0, t, "PRE_ACCEPTED", 10, command=cmd,
+                          command_store=store)
+    cmd2 = Command(t)
+    cmd2.promised = Ballot(1, 20, 3)   # regressed below the promise
+    with pytest.raises(AuditViolation) as exc:
+        auditor.on_transition(3, 0, t, "ACCEPTED", 20, command=cmd2,
+                              command_store=store)
+    assert exc.value.rule == rules.RULE_BALLOT_REGRESSION
+    assert "promised" in exc.value.detail
+
+
+def test_execute_at_mismatch_and_invalidate_conflict():
+    auditor = InvariantAuditor(mode="warn")
+    store_a = _FakeStore(1, 0, Ranges.of(Range(IntKey(0), IntKey(100))))
+    store_b = _FakeStore(2, 0, Ranges.of(Range(IntKey(0), IntKey(100))))
+    t = tid(900)
+    c1 = Command(t)
+    c1.execute_at = Timestamp(1, 950, 1)
+    auditor.on_transition(1, 0, t, "PRE_COMMITTED", 10, command=c1,
+                          command_store=store_a)
+    c2 = Command(t)
+    c2.execute_at = Timestamp(1, 960, 1)   # different decided executeAt
+    auditor.on_transition(2, 0, t, "PRE_COMMITTED", 20, command=c2,
+                          command_store=store_b)
+    assert [v.rule for v in auditor.violations] == \
+        [rules.RULE_EXECUTE_AT_MISMATCH]
+    # a decided txn observed INVALIDATED anywhere: the quarantine-bug shape
+    auditor2 = InvariantAuditor(mode="warn")
+    auditor2.on_transition(1, 0, t, "PRE_COMMITTED", 10, command=c1,
+                           command_store=store_a)
+    c3 = Command(t)
+    auditor2.on_transition(2, 0, t, "INVALIDATED", 20, command=c3,
+                           command_store=store_b)
+    assert [v.rule for v in auditor2.violations] == \
+        [rules.RULE_COMMIT_INVALIDATE_CONFLICT]
+
+
+def test_execute_at_uniqueness():
+    auditor = InvariantAuditor(mode="warn")
+    store = _FakeStore(1, 0, Ranges.of(Range(IntKey(0), IntKey(100))))
+    shared = Timestamp(1, 1000, 1)
+    for i, t in enumerate((tid(900), tid(901, node=2))):
+        cmd = Command(t)
+        cmd.execute_at = shared
+        auditor.on_transition(1, 0, t, "PRE_COMMITTED", 10 + i, command=cmd,
+                              command_store=store)
+    assert [v.rule for v in auditor.violations] == \
+        [rules.RULE_EXECUTE_AT_DUPLICATE]
+
+
+def test_crash_rebaselines_lifecycle_state():
+    """A journal replay re-observes commands at their durable tier: after
+    on_crash the first re-observation per txn is a baseline, not an edge."""
+    auditor = InvariantAuditor(mode="strict")
+    t = tid(1100)
+    auditor.on_transition(4, 0, t, "PRE_APPLIED", 8)
+    auditor.on_transition(4, 0, t, "APPLYING", 9)
+    auditor.on_transition(4, 0, t, "APPLIED", 10)
+    auditor.on_crash(4)
+    # replay re-observes at a LOWER tier — legal during the replay window
+    auditor.on_transition(4, 0, t, "STABLE", 20)
+    auditor.on_restart(4)
+    auditor.on_transition(4, 0, t, "READY_TO_EXECUTE", 30)   # live edge again
+    assert auditor.violations == []
+    # but an illegal live edge after restart still raises
+    with pytest.raises(AuditViolation):
+        auditor.on_transition(4, 0, t, "COMMITTED", 40)
+
+
+def test_crash_drops_deps_records_with_volatile_state():
+    """A post-restart recovery may re-stabilize with a different (legal)
+    cover: the pre-crash stable-deps record must not trip deps_mutated."""
+    from cassandra_accord_tpu.local.status import SaveStatus  # noqa: F401
+    ranges = Ranges.of(Range(IntKey(0), IntKey(100)))
+    store = _FakeStore(4, 0, ranges)
+    t = tid(1200)
+    rk = IntKey(10).to_routing()
+
+    def stable_cmd(deps):
+        cmd = Command(t)
+        cmd.execute_at = Timestamp(1, 1250, 1)
+        cmd.partial_deps = deps
+        cmd.accepted_or_committed = Ballot.ZERO
+        return cmd
+
+    auditor = InvariantAuditor(mode="strict")
+    auditor.on_transition(4, 0, t, "STABLE", 10,
+                          command=stable_cmd(
+                              Deps(key_deps=KeyDeps.of({rk: [tid(1100)]}))),
+                          command_store=store)
+    auditor.on_crash(4)
+    # replay re-baselines; recovery then re-stabilizes with a DIFFERENT cover
+    auditor.on_transition(4, 0, t, "STABLE", 20,
+                          command=stable_cmd(Deps(key_deps=KeyDeps.of({rk: []}))),
+                          command_store=store)
+    auditor.on_restart(4)
+    cmd = stable_cmd(Deps(key_deps=KeyDeps.of({rk: []})))
+    auditor.on_transition(4, 0, t, "PRE_APPLIED", 30, command=cmd,
+                          command_store=store)
+    assert auditor.violations == []
+
+
+def test_slo_unapplied_rearms_after_dormancy():
+    """The SLO scan must not stay dormant past a late decision: a txn that
+    decides after every pre-decision deadline passed still gets its
+    unapplied deadline scheduled and flagged."""
+    auditor = InvariantAuditor(mode="warn", slo_unattended_s=1.0,
+                               slo_undecided_s=2.0, slo_unapplied_s=3.0)
+    store = _FakeStore(1, 0, Ranges.of(Range(IntKey(0), IntKey(100))))
+    t = tid(1400)
+    auditor.on_submit(0, t, 1, 0)
+    auditor.on_recovery(1, t, Ballot(1, 1, 1), 100)   # attempt attributed
+    # sim time passes BOTH pre-decision deadlines: undecided flag opens and
+    # the scan has no future deadline left (dormant)
+    auditor.on_message_event("DELIVER", 1, 2, 1, object(), 2_500_000)
+    assert {f["kind"] for f in auditor.open_slo_flags()} == \
+        {rules.SLO_UNDECIDED}
+    # the txn NOW decides: the unapplied deadline must be re-armed
+    cmd = Command(t)
+    cmd.execute_at = Timestamp(1, 1500, 1)
+    auditor.on_transition(1, 0, t, "PRE_COMMITTED", 3_000_000, command=cmd,
+                          command_store=store)
+    auditor.on_message_event("DELIVER", 1, 2, 2, object(), 6_500_000)
+    assert {f["kind"] for f in auditor.open_slo_flags()} == \
+        {rules.SLO_UNAPPLIED}
+
+
+# ---------------------------------------------------------------------------
+# liveness SLO flags
+# ---------------------------------------------------------------------------
+
+def test_slo_unattended_flag_opens_and_closes():
+    auditor = InvariantAuditor(mode="strict", slo_unattended_s=1.0,
+                               slo_undecided_s=100.0, slo_unapplied_s=100.0)
+    t = tid(1300)
+    auditor.on_submit(0, t, 1, 0)
+    # sim time passes the budget with no attempt: flag opens (never raises)
+    auditor.on_message_event("DELIVER", 1, 2, 1, object(), 2_000_000)
+    flags = auditor.open_slo_flags()
+    assert len(flags) == 1 and flags[0]["kind"] == rules.SLO_UNATTENDED
+    assert flags[0]["txn_id"] == str(t)
+    # a recovery attempt attributed to the txn closes it
+    auditor.on_recovery(2, t, Ballot(1, 1, 2), 2_500_000)
+    assert auditor.open_slo_flags() == []
+    hist = auditor.slo_flag_history()
+    assert hist[0]["closed_because"] == "recovery attempt attributed"
+    assert auditor.verdict()["slo_flags_raised"] == 1
+    assert auditor.verdict()["slo_flags_open"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the tentpole invariant: zero observer effect under strict audit
+# ---------------------------------------------------------------------------
+
+def test_zero_observer_effect_strict_audit_hostile():
+    """Same-seed hostile burn, --audit=strict vs no observer: identical full
+    message traces and outcomes — the auditor's checks never perturb the
+    simulation."""
+    ta, tb = Trace(), Trace()
+    bare = run_burn(9, tracer=ta.hook, **HOSTILE)
+    audited = run_burn(9, tracer=tb.hook, audit="strict", **HOSTILE)
+    divergence = diff_traces(ta, tb)
+    assert divergence is None, \
+        f"the auditor perturbed the simulation:\n{divergence}"
+    assert (bare.ops_ok, bare.ops_recovered, bare.ops_nacked, bare.ops_lost,
+            bare.ops_failed, bare.sim_micros) == \
+           (audited.ops_ok, audited.ops_recovered, audited.ops_nacked,
+            audited.ops_lost, audited.ops_failed, audited.sim_micros)
+    assert audited.audit is not None
+    assert audited.audit["violations"] == 0
+    assert audited.audit["events_audited"] > 0
+
+
+def test_benign_burn_strict_audit_clean():
+    r = run_burn(11, ops=30, concurrency=6, audit="strict")
+    assert r.audit["violations"] == 0
+    assert r.audit["mode"] == "strict"
+    assert r.audit["slo_flags_open"] == 0
+
+
+def test_audit_rejects_plain_flight_recorder():
+    from cassandra_accord_tpu.observe import FlightRecorder
+    with pytest.raises(ValueError, match="InvariantAuditor"):
+        run_burn(11, ops=5, audit="strict", observer=FlightRecorder())
+    with pytest.raises(ValueError, match="off/strict/warn"):
+        run_burn(11, ops=5, audit="bogus")
+
+
+# ---------------------------------------------------------------------------
+# burn CLI: --audit smoke (the tier-1 CI satellite) + watchdog integration
+# ---------------------------------------------------------------------------
+
+def test_burn_cli_audit_strict_smoke(tmp_path):
+    """One short burn seed under --audit=strict: passes, and the --json
+    summary carries the per-seed audit verdict."""
+    from cassandra_accord_tpu.harness import burn as burn_cli
+    j = tmp_path / "j.json"
+    burn_cli.main(["--seeds", "1", "--ops", "20", "--no-cache-miss",
+                   "--audit", "strict", "--json", str(j)])
+    entry = json.loads(j.read_text())["results"][0]
+    assert entry["status"] == "pass"
+    assert entry["audit"]["mode"] == "strict"
+    assert entry["audit"]["violations"] == 0
+    assert "slo_flags_open" in entry["audit"]
+    json.dumps(entry["audit"])   # the verdict is JSON-clean end to end
+
+
+def test_watchdog_dump_includes_audit_section():
+    from cassandra_accord_tpu.harness.burn import last_cluster
+    from cassandra_accord_tpu.harness.watchdog import dump_wait_state
+    auditor = InvariantAuditor(mode="warn", slo_unattended_s=0.001)
+    run_burn(11, ops=10, concurrency=4, observer=auditor, audit="warn")
+    cluster = last_cluster()
+    assert cluster is not None   # pinned by auditor.attach_cluster
+    dump = dump_wait_state(cluster)
+    assert "audit: " in dump
+    assert "slo_flags_raised" in dump

@@ -288,6 +288,49 @@ def test_chrome_trace_valid_and_counts_agree():
                for e in doc["traceEvents"])
 
 
+def test_chrome_trace_counter_tracks():
+    """Counter-track satellite: ``C`` events sampled on sim-time buckets for
+    in-flight txns and recovery attempts, derived at EXPORT time (no runtime
+    sampling), on the synthetic counters pid; schema-checked."""
+    from cassandra_accord_tpu.observe.export import COUNTER_PID, counter_events
+    rec = FlightRecorder()
+    run_burn(13, **HOSTILE, observer=rec)
+    doc = rec.chrome_trace()
+    assert validate_chrome_trace(doc) == []
+    cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+    assert cs, "no counter events exported"
+    assert {e["name"] for e in cs} == {"in_flight_txns", "recovery_attempts"}
+    assert all(e["pid"] == COUNTER_PID for e in cs)
+    inflight = [e for e in cs if e["name"] == "in_flight_txns"]
+    # sampled series: monotone time, in-flight returns to 0 once all resolve
+    times = [e["ts"] for e in inflight]
+    assert times == sorted(times)
+    assert inflight[-1]["args"]["in_flight"] == 0
+    assert max(e["args"]["in_flight"] for e in inflight) > 0
+    rec2 = [e for e in cs if e["name"] == "recovery_attempts"]
+    assert rec2[-1]["args"]["recoveries"] >= rec2[0]["args"]["recoveries"]
+    # the counters process is named in metadata
+    assert any(e["ph"] == "M" and e["pid"] == COUNTER_PID
+               and e["args"]["name"] == "cluster counters"
+               for e in doc["traceEvents"])
+    # an empty recorder exports no counter track (and stays schema-valid)
+    empty = FlightRecorder()
+    assert counter_events(empty) == []
+    assert validate_chrome_trace(empty.chrome_trace()) == []
+
+
+def test_validate_chrome_trace_rejects_bad_counter_events():
+    base = {"name": "x", "cat": "counter", "ph": "C", "ts": 1, "pid": 0,
+            "tid": 0}
+    bad_missing = dict(base)                      # no args at all
+    bad_type = dict(base, args={"v": "high"})     # non-numeric series
+    ok = dict(base, args={"v": 3})
+    assert validate_chrome_trace({"traceEvents": [ok]}) == []
+    for bad in (bad_missing, bad_type):
+        problems = validate_chrome_trace({"traceEvents": [bad]})
+        assert problems, f"accepted invalid C event {bad}"
+
+
 def test_message_ring_bounds_flight_recorder():
     rec = FlightRecorder(message_ring=500)
     run_burn(11, ops=30, concurrency=6, observer=rec)

@@ -13,6 +13,15 @@ from cassandra_accord_tpu.primitives.timestamp import TxnId, TxnKind, Domain
 
 T, K, B = 64, 32, 16  # T divisible by 8 devices
 
+# the mesh tests shard over 8 devices (conftest requests 8 virtual CPU
+# devices via XLA_FLAGS; a pre-initialized jax or an overriding environment
+# can leave fewer) — skip with the reason instead of failing on environment
+needs_8_devices = pytest.mark.skipif(
+    jax.device_count() < 8,
+    reason=f"needs 8 JAX devices for the sharding mesh, "
+           f"have {jax.device_count()} (conftest's virtual-device request "
+           f"did not take effect in this environment)")
+
 
 def _batch(rng, base_hlc, slots):
     key_inc = np.zeros((B, K), dtype=np.int8)
@@ -33,6 +42,7 @@ def _batch(rng, base_hlc, slots):
         valid=jnp.ones((B,), dtype=jnp.bool_))
 
 
+@needs_8_devices
 def test_sharded_step_matches_single_device():
     assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
     rng = np.random.default_rng(3)
@@ -55,6 +65,7 @@ def test_sharded_step_matches_single_device():
         assert (np.asarray(a) == np.asarray(b)).all(), name
 
 
+@needs_8_devices
 def test_sharded_closure_matches():
     rng = np.random.default_rng(5)
     adj = np.tril(rng.random((T, T)) < 0.08, k=-1).astype(np.int8)
@@ -65,6 +76,7 @@ def test_sharded_closure_matches():
     assert (got == want).all()
 
 
+@needs_8_devices
 def test_sharded_store_consult_matches_single_device():
     """The PROTOCOL plane over the mesh: per-store consults sharded one store
     per device + cross-store timestamp-proposal reduce must equal running the
@@ -109,6 +121,7 @@ def test_sharded_store_consult_matches_single_device():
     assert (np.asarray(gmax) == want).all()
 
 
+@needs_8_devices
 def test_sharded_frontier_matches():
     from cassandra_accord_tpu.ops import deps_kernels as dk
     S, Ts = 8, 16
@@ -126,6 +139,7 @@ def test_sharded_frontier_matches():
         assert (got[s] == want).all(), s
 
 
+@needs_8_devices
 def test_live_state_sharded_consult_parity():
     """The live-state multichip path (parallel/live_dryrun.py): a real burn
     builds every store's device index; the burn's own recorded consults are
