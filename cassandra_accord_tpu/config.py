@@ -115,6 +115,20 @@ class LocalConfig:
     tpu_host_engine: str = "auto"           # auto | numpy | native
     tpu_dispatch_elems: Optional[float] = None  # device-tier threshold override
 
+    # -- persistent batched device consult service (device_service/) ---------
+    # "auto"/"on": the resolver's device tier routes through the persistent
+    # service (incremental double-buffered index refresh, ragged batching
+    # window, futures API); "off": legacy one-shot dispatch (whole-index
+    # re-upload per consult — the r05 replay wedge; kept as a bench baseline)
+    tpu_service: str = "auto"               # auto | on | off
+    # jax = the fused kernel wherever jax placed the buffers (TPU or the CPU
+    # backend — both count as the kernel tier); host = deterministic numpy
+    # fallback (bit-identical answers, dispatched eagerly per window);
+    # auto = jax whenever a usable jax runtime exists, host otherwise
+    tpu_service_backend: str = "auto"       # auto | jax | host
+    tpu_service_max_window: int = 256       # row-bucket cap per dispatch
+    tpu_service_refresh_full_frac: float = 0.25  # dirty fraction -> full upload
+
     _ENV_FIELDS = (
         ("ACCORD_RESTART_INTERVAL", "restart_interval_s", float),
         ("ACCORD_RESTART_DOWNTIME_MIN", "restart_downtime_min_s", float),
@@ -139,6 +153,10 @@ class LocalConfig:
         ("ACCORD_TPU_F32_MAX", "tpu_f32_max", int),
         ("ACCORD_TPU_HOST_TIER", "tpu_host_engine", str),
         ("ACCORD_TPU_DISPATCH_ELEMS", "tpu_dispatch_elems", float),
+        ("ACCORD_TPU_SERVICE", "tpu_service", lambda v: v.lower()),
+        ("ACCORD_TPU_SERVICE_BACKEND", "tpu_service_backend",
+         lambda v: v.lower()),
+        ("ACCORD_TPU_SERVICE_MAX_WINDOW", "tpu_service_max_window", int),
     )
 
     @classmethod

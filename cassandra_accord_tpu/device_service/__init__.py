@@ -1,0 +1,14 @@
+"""Persistent batched device consult service (the protocol-path device tier).
+
+``DeviceConsultService`` (service.py) owns a persistent, incrementally
+refreshed device-resident conflict index (index.py), a ragged batching
+window with jit-stable bucket shapes (batch.py), and a futures-based
+submission API the resolver routes protocol consults through.  See each
+module's docstring; README "Device consult service" for the operator view.
+"""
+from .batch import ConsultBatch, build_batch, pow2_bucket, split_rows
+from .index import DoubleBufferedIndex
+from .service import AsyncResult, DeviceConsultService
+
+__all__ = ["AsyncResult", "ConsultBatch", "DeviceConsultService",
+           "DoubleBufferedIndex", "build_batch", "pow2_bucket", "split_rows"]

@@ -1,0 +1,69 @@
+"""The service's fused ragged consult kernel (pre-transposed operands).
+
+Same join semantics as ``ops.deps_kernels.consult`` — key-overlap matmul ×
+started-before lex compare × kind-witness mask, plus the masked lex max for
+the timestamp proposal — but consuming the DoubleBufferedIndex layout:
+
+- incidence comes in PRE-TRANSPOSED and PRE-CAST ([K, T] in the matmul
+  dtype): the one-shot kernel casts its int8 [T, K] operands per call, which
+  at replay scale is a multi-GB cast PER CONSULT (the dominant term of the
+  r05 wedge on the CPU backend, and wasted HBM bandwidth on the MXU);
+- the ragged query batch densifies ON DEVICE: flat key columns + row ids +
+  weights scatter into the [B, K] mask (weight 0 = padding, scatters
+  nothing; duplicate keys accumulate >1, consumed only as nonzero).
+
+Bit-identical answers to the one-shot kernel and the host tiers (the parity
+property tests drive all of them over the same randomized ragged batches).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+_CONSULT_T = None
+
+
+def consult_t():
+    """The jitted kernel (lazy: importing jax only when a dispatch happens)."""
+    global _CONSULT_T
+    if _CONSULT_T is not None:
+        return _CONSULT_T
+
+    import jax
+    import jax.numpy as jnp
+    from ..ops.deps_kernels import WITNESSES, _lex_max_masked
+    from ..ops.graph_state import INVALIDATED, ts_less
+
+    @partial(jax.jit, static_argnames=("packed",))
+    def ragged_consult_t(live_T, key_T, ts, txn_id, kind, status, active,
+                         flat_cols, row_ids, weights, before, qkind,
+                         packed=False):
+        b = before.shape[0]
+        k, t = live_T.shape
+        dt = live_T.dtype
+        q = jnp.zeros((b, k), dtype=dt) \
+            .at[row_ids, flat_cols].add(weights.astype(dt))
+        dn = (((1,), (0,)), ((), ()))
+        share_live = jax.lax.dot_general(
+            q, live_T, dn, preferred_element_type=jnp.float32) > 0.0   # [B, T]
+        share_full = jax.lax.dot_general(
+            q, key_T, dn, preferred_element_type=jnp.float32) > 0.0    # [B, T]
+        started = ts_less(txn_id[None, :, :], before[:, None, :])      # [B, T]
+        wit = WITNESSES[qkind[:, None].astype(jnp.int32),
+                        kind[None, :].astype(jnp.int32)]               # [B, T]
+        eligible = active & (status != INVALIDATED)                    # [T]
+        deps = share_live & started & wit & eligible[None, :]
+        mc_mask = share_full & active[None, :]
+        per_slot = jnp.where(ts_less(ts, txn_id)[:, None], txn_id, ts)  # [T,5]
+        max_lanes = _lex_max_masked(
+            jnp.broadcast_to(per_slot[None, :, :],
+                             mc_mask.shape + (per_slot.shape[-1],)), mc_mask)
+        if packed:
+            # transfer-bound regime: bit-pack the deps mask before it leaves
+            # HBM (8× smaller result; hosts unpack with np.unpackbits)
+            bits = deps.reshape(b, t // 8, 8).astype(jnp.uint32)
+            w8 = jnp.asarray([1, 2, 4, 8, 16, 32, 64, 128], dtype=jnp.uint32)
+            deps = jnp.sum(bits * w8, axis=-1).astype(jnp.uint8)
+        return deps, max_lanes
+
+    _CONSULT_T = ragged_consult_t
+    return _CONSULT_T

@@ -791,7 +791,9 @@ def reconcile(seed: int, **kwargs):
         f"nondeterministic outcome for seed {seed}: {a} vs {b}"
     # tier-choice counters are cost-model (wall-clock) driven, not sim-driven:
     # exclude them from the determinism contract (answers are tier-invariant)
-    tier_keys = ("resolver_host_consults", "resolver_native_consults", "resolver_device_consults")
+    tier_keys = ("resolver_host_consults", "resolver_native_consults",
+                 "resolver_device_consults", "resolver_service_submitted",
+                 "resolver_service_batches")
     sa = {k: v for k, v in a.stats.items() if k not in tier_keys}
     sb = {k: v for k, v in b.stats.items() if k not in tier_keys}
     assert sa == sb, \

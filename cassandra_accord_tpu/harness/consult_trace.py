@@ -25,10 +25,13 @@ closes that gap with PROTOCOL-SEMANTICS traffic at data-plane scale:
 
 3. **Tier comparison** — the same stream replays under each execution tier
    (``walk`` = the scalar cfk oracle, ``host`` = vectorized numpy,
-   ``device`` = the fused MXU consult, ``auto`` = the production cost model),
-   yielding queries/s and commits-equivalent/s (total commits the recorded
-   protocol achieved per consult workload, scaled by copies).  A sampled
-   parity check asserts the tiers agree answer-for-answer.
+   ``device`` = the fused consult through the PERSISTENT batched service
+   (device_service/: incremental double-buffered index refresh + ragged
+   batching windows — the r05 one-shot path re-uploaded the whole T×K index
+   per consult and wedged at event 36), ``auto`` = the production cost
+   model), yielding queries/s and commits-equivalent/s (total commits the
+   recorded protocol achieved per consult workload, scaled by copies).  A
+   sampled parity check asserts the tiers agree answer-for-answer.
 """
 from __future__ import annotations
 
@@ -473,10 +476,17 @@ def replay_stream(events: List[tuple], tier: str,
         out["truncated_at_event"] = truncated_at
         out["events_total"] = len(events)
     for tele in ("walk_consults", "host_consults", "device_consults",
-                 "prefetch_hits", "prefetch_patched", "prefetch_misses"):
+                 "prefetch_hits", "prefetch_patched", "prefetch_misses",
+                 "service_submitted", "service_batches"):
         v = getattr(resolver, tele, None)
         if v:
             out[tele] = v
+    svc = getattr(resolver, "_service_obj", None)
+    if svc is not None:
+        # the persistent-service health block: batching behavior, refresh
+        # traffic, and the bounded-compilation ledger (jit_shapes) — the
+        # replay used to wedge here on whole-index re-uploads (r05)
+        out["service"] = svc.stats()
     idx = getattr(resolver, "indexed_count", None)
     if idx is not None:
         out["final_indexed"] = idx()

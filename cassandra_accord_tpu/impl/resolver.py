@@ -10,7 +10,11 @@ module makes that boundary explicit so the SAME protocol code runs against:
   CommandsForKey lists (exactly the reference's scalar scan shape);
 - ``TpuDepsResolver``  — the device data plane (impl/tpu_resolver.py): the
   store's conflict index lives on-device as a GraphState and every query is a
-  batched MXU join (ops.deps_kernels.overlap_join / max_conflict_keys);
+  batched MXU join (ops.deps_kernels.overlap_join / max_conflict_keys).  Its
+  device tier routes through the PERSISTENT batched consult service
+  (device_service/: incrementally-refreshed double-buffered index, ragged
+  batching windows, ``submit(txn_keys) -> AsyncResult`` futures) unless
+  ``tpu_service=off`` selects the legacy one-shot dispatch;
 - ``VerifyDepsResolver`` — runs both and asserts bit-identical results on
   every query ("deps-graph parity"); used by tests and the burn harness.
 

@@ -78,6 +78,7 @@ from typing import Dict, List, Optional, Set, Tuple, TYPE_CHECKING
 
 import numpy as np
 
+from ..device_service.service import AsyncResult
 from ..primitives.keys import Range, RoutingKey
 from ..primitives.timestamp import Timestamp, TxnId, TxnKind
 from ..utils.invariants import check_state
@@ -123,6 +124,12 @@ def _pack_before(before: Timestamp) -> Tuple[int, int, int, int, int]:
     except Exception:  # noqa: BLE001 — bound exceeds device packing range
         m = 0x7FFFFFFF
         return (m, m, m, m, m)
+
+
+def _post_mc(raw):
+    """Deferred-mc post-processor: unpack the service's max lanes."""
+    ts = Timestamp.unpack_lanes(tuple(int(v) for v in raw[1]))
+    return None if ts == Timestamp.NONE else ts
 
 
 def _lex_less(a: np.ndarray, b: np.ndarray) -> np.ndarray:
@@ -222,10 +229,29 @@ class TpuDepsResolver(DepsResolver):
         # model prefers the device tier at that scale anyway)
         self._f32_max = cfg.tpu_f32_max
         self._walk: Optional[DepsResolver] = None
+        # consult counters: ONE increment PER SUBMITTED CONSULT (a batched
+        # launch of B queries counts B — the r03-comparable bookkeeping; the
+        # old per-launch counting understated device traffic by the batch
+        # factor and made device-vs-host ratios incomparable)
         self.walk_consults = 0
         self.host_consults = 0
         self.native_consults = 0
         self.device_consults = 0
+        # persistent batched device consult service (device_service/): owns
+        # the device-resident index (incremental double-buffered refresh),
+        # the ragged batching window, and the futures submission API.  The
+        # device tier routes through it unless tpu_service == "off" (legacy
+        # one-shot dispatch, kept as the bench baseline).
+        self.service_enabled = cfg.tpu_service != "off"
+        self._service_obj = None
+        # rows of the canonical index touched since the service last
+        # refreshed its buffers (None = full upload needed: first sight,
+        # capacity growth, host rebuild)
+        self._dirty_rows: Optional[Set[int]] = None
+        # slot high-watermarks: min-heap allocation keeps live slots a
+        # prefix, so these bound the service's occupancy-view extents
+        self._max_slot = -1
+        self._max_key_slot = -1
         # host-tier engine: 'auto' uses the native C++ consult when built and
         # the query key-counts are sparse (its O(B*T*k_q) walk beats the
         # dense BLAS pass), 'numpy'/'native' force a rung
@@ -242,6 +268,29 @@ class TpuDepsResolver(DepsResolver):
         self.prefetch_hits = 0
         self.prefetch_patched = 0
         self.prefetch_misses = 0
+
+    # -- the persistent device consult service --------------------------------
+    def service(self):
+        """This resolver's DeviceConsultService (lazy; one per store)."""
+        if self._service_obj is None:
+            from ..device_service.service import DeviceConsultService
+            self._service_obj = DeviceConsultService(self, config=self.config)
+        return self._service_obj
+
+    def take_dirty_rows(self) -> Optional[Set[int]]:
+        """Rows changed since the service's last buffer refresh (None = the
+        whole index must re-upload).  Consumes the tracking set."""
+        rows = self._dirty_rows
+        self._dirty_rows = set()
+        return rows
+
+    @property
+    def service_submitted(self) -> int:
+        return self._service_obj.submitted if self._service_obj else 0
+
+    @property
+    def service_batches(self) -> int:
+        return self._service_obj.batches if self._service_obj else 0
 
     # -- registration (cfk.update semantics) ---------------------------------
     def register(self, txn_id: TxnId, status, execute_at, keys) -> None:
@@ -572,6 +621,32 @@ class TpuDepsResolver(DepsResolver):
         if not live:
             return
         b = len(live)
+        if self._use_service(b):
+            # futures path: the window's consults submit as ONE ragged batch
+            # into the persistent service; nothing dispatches until the first
+            # cached answer is DEMANDED (a fully-invalidated window costs
+            # zero launches), and every answer is computed against the index
+            # snapshot pinned here (service.begin_window) — byte-identical
+            # to the eager path, since the cache exactness rules only serve
+            # answers whose inputs did not change since the prefetch
+            self._flush()
+            svc = self.service()
+            svc.begin_window()
+            for sig, op, known, before in live:
+                cols = [self.key_slot[rk] for rk in known]
+                if op == "kc":
+                    self._cache[sig] = svc.submit(
+                        cols, _pack_before(before), int(sig[1].kind),
+                        post=self._post_kc(known))
+                else:
+                    self._cache[sig] = svc.submit(
+                        cols, (0, 0, 0, 0, 0), 0, post=_post_mc)
+            if not svc.deferred:
+                # host fallback: no pinned snapshot — answer the window NOW
+                # so mid-window mutations cannot leak into (and duplicate
+                # against) the cache's delta patching
+                svc.flush_window()
+            return
         q = np.zeros((b, self._k), dtype=np.int8)
         before_lanes = np.zeros((b, TS_LANES), dtype=np.int32)
         kind = np.zeros((b,), dtype=np.int8)
@@ -593,6 +668,29 @@ class TpuDepsResolver(DepsResolver):
         self._cache = None
         self._cache_dirty = {}
         self._cache_hard = set()
+        if self._service_obj is not None:
+            self._service_obj.end_window()
+
+    def _use_service(self, b: int) -> bool:
+        """Route this window/batch through the persistent device service?
+        Same cost gate as the device tier (the service IS the device tier
+        when enabled)."""
+        if not self.service_enabled:
+            return False
+        if self.tier == "device":
+            return True
+        return self.tier == "auto" \
+            and b * self._t * self._k >= self._device_threshold()
+
+    def _post_kc(self, known):
+        """Attribution post-processor for a deferred kc consult (applied at
+        demand time; exactness of demand-time attribution is guaranteed by
+        the cache dirty/hard rules — any input change forces a fallback)."""
+        known_set = set(known)
+
+        def post(raw):
+            return self._attribute(raw[0], known_set)
+        return post
 
     def _fast(self, rk: RoutingKey, before: Timestamp) -> bool:
         """Covered bits implement elision exactly for this (key, bound) iff
@@ -642,11 +740,21 @@ class TpuDepsResolver(DepsResolver):
                     # (the Accept deps walk at before=executeAt) — are patched
                     # from the mirrors under the exact same predicates
                     delta_ids.add(d)
+        ans = self._cache[sig]
+        if isinstance(ans, AsyncResult):
+            # deferred service consult: first demand dispatches the whole
+            # window in one launch; memoize so repeated hits stay O(1)
+            ans = ans.result()
+            if ans is None:
+                # superseded-window safety net fired: no answer — fall back
+                self.prefetch_misses += 1
+                return False, None, None
+            self._cache[sig] = ans
         if delta_ids:
             self.prefetch_patched += 1
         else:
             self.prefetch_hits += 1
-        return True, self._cache[sig], delta_ids
+        return True, ans, delta_ids
 
     # -- execution-frontier plane ---------------------------------------------
     def is_indexed(self, txn_id: TxnId) -> bool:
@@ -859,6 +967,10 @@ class TpuDepsResolver(DepsResolver):
         if self.tier == "device" or (
                 self.tier == "auto"
                 and b * self._t * self._k >= self._device_threshold()):
+            if self.service_enabled:
+                # the persistent service: incremental buffer refresh + ragged
+                # launch (vs the legacy one-shot whole-index re-upload below)
+                return self.service().consult_rows(q, before, kind)
             return self._consult_device(q, before, kind)
         return self._consult_host(q, before, kind, want_deps, want_max)
 
@@ -887,13 +999,13 @@ class TpuDepsResolver(DepsResolver):
                 qcols = [np.nonzero(row)[0] for row in q]
                 nnz = sum(len(c) for c in qcols)
                 if self._host_engine == "native" or nnz <= 8 * len(qcols):
-                    self.native_consults += 1
+                    self.native_consults += len(qcols)
                     _, invalidated_i = _status_codes()
                     deps, max_lanes = native.consult_batch(
                         self._h, qcols, before, kind, invalidated_i,
                         want_deps=want_deps, want_max=want_max)
                     return deps, max_lanes
-        self.host_consults += 1
+        self.host_consults += q.shape[0]
         h = self._h
         if "key_inc_f32" not in h:
             # above the f32-mirror bound: cast per call (the cost model rarely
@@ -935,7 +1047,7 @@ class TpuDepsResolver(DepsResolver):
         import jax
         import jax.numpy as jnp
         from ..ops import deps_kernels as dk
-        self.device_consults += 1
+        self.device_consults += q.shape[0]
         self._sync_device()
         b = q.shape[0]
         b_pad = 1 << max(0, b - 1).bit_length()
@@ -1051,12 +1163,18 @@ class TpuDepsResolver(DepsResolver):
     def _alloc_slot(self) -> int:
         if not self.free_slots:
             self._grow(txns=True)
-        return heapq.heappop(self.free_slots)
+        slot = heapq.heappop(self.free_slots)
+        if slot > self._max_slot:
+            self._max_slot = slot   # occupancy watermark (service view extent)
+        return slot
 
     def _alloc_key_slot(self) -> int:
         if not self.free_key_slots:
             self._grow(txns=False)
-        return heapq.heappop(self.free_key_slots)
+        slot = heapq.heappop(self.free_key_slots)
+        if slot > self._max_key_slot:
+            self._max_key_slot = slot
+        return slot
 
     def _grow(self, txns: bool) -> None:
         """Double capacity and rebuild the index arrays from host mirrors."""
@@ -1107,6 +1225,7 @@ class TpuDepsResolver(DepsResolver):
             self._h["live_f32"] = np.ascontiguousarray(
                 live_inc.T.astype(np.float32))
         self._device_clean = False
+        self._dirty_rows = None   # shapes changed: the service re-uploads
         self._dirty_txns.clear()
         self._clear_bits.clear()
         self._deactivate.clear()
@@ -1123,6 +1242,14 @@ class TpuDepsResolver(DepsResolver):
         if not (self._dirty_txns or self._clear_bits or self._deactivate
                 or self._live_ops):
             return
+        if self._dirty_rows is not None:
+            # row provenance for the service's incremental buffer refresh
+            # (collected BEFORE the buffers below are consumed)
+            self._dirty_rows.update(row for row, _ in self._clear_bits)
+            self._dirty_rows.update(self._deactivate)
+            self._dirty_rows.update(self.txns[tid].slot
+                                    for tid in self._dirty_txns)
+            self._dirty_rows.update(op[0] for op in self._live_ops)
         h = self._h
         f32 = "key_inc_f32" in h
         # order matters: clears and deactivations target OLD occupants of a

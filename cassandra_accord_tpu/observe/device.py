@@ -11,7 +11,8 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
-from .schema import RESOLVER_COUNTERS, RESOLVER_METRICS
+from .schema import (RESOLVER_COUNTERS, RESOLVER_METRICS,
+                     SERVICE_BATCH_SIZE_METRIC, SERVICE_STAT_METRICS)
 
 # one v5p-class chip's bf16 matmul peak, the MFU denominator bench.py reports
 PEAK_BF16_TFLOPS = 275.0
@@ -41,9 +42,30 @@ def cluster_resolver_totals(cluster) -> Dict[str, int]:
     return totals
 
 
+def service_of(resolver):
+    """The store's DeviceConsultService, if one was ever engaged (unwraps
+    the verify resolver); None otherwise."""
+    r = getattr(resolver, "tpu", resolver)
+    return getattr(r, "_service_obj", None)
+
+
+def cluster_services(cluster):
+    """Every engaged per-store consult service in the cluster."""
+    out = []
+    for node in cluster.nodes.values():
+        for store in node.command_stores.all_stores():
+            svc = service_of(store.resolver)
+            if svc is not None:
+                out.append((node.id, store.id, svc))
+    return out
+
+
 def collect_into(registry, cluster) -> None:
     """Pull-collect per-store resolver counters (and cluster totals) into a
-    MetricsRegistry as gauges under the schema's ``resolver.*`` names."""
+    MetricsRegistry as gauges under the schema's ``resolver.*`` names, plus
+    the consult-service stats under ``service.*`` (queue/batching behavior:
+    batch-size histogram, window occupancy, dispatch latency, refresh
+    traffic)."""
     totals = {name: 0 for name in RESOLVER_COUNTERS}
     seen = False
     for node in cluster.nodes.values():
@@ -59,6 +81,28 @@ def collect_into(registry, cluster) -> None:
     if seen:
         for name, value in totals.items():
             registry.gauge(RESOLVER_METRICS[name]).set(value)
+    for node_id, store_id, svc in cluster_services(cluster):
+        stats = svc.stats()
+        for name, metric in SERVICE_STAT_METRICS.items():
+            registry.gauge(metric, node=node_id, store=store_id) \
+                .set(stats[name])
+        # batch sizes are bounded by the window row cap (default 256): pow2
+        # bounds, NOT the sim-time latency defaults (everything would land
+        # in the first 1000us bucket)
+        hist = registry.histogram(SERVICE_BATCH_SIZE_METRIC, node=node_id,
+                                  store=store_id,
+                                  bounds=(1, 2, 4, 8, 16, 32, 64, 128, 256))
+        # record only the DELTA since this service was last collected:
+        # collect_cluster runs again on the failure path (and on any later
+        # metrics_snapshot), and Histogram.record is additive
+        reported = getattr(svc, "_hist_reported", None)
+        if reported is None:
+            reported = svc._hist_reported = {}
+        for rows, count in svc.batch_size_hist.items():
+            delta = count - reported.get(rows, 0)
+            if delta > 0:
+                hist.record_many(rows, delta)
+            reported[rows] = count
 
 
 # -- kernel roofline accounting (bench.py) -----------------------------------

@@ -79,6 +79,40 @@ def counter_events(recorder, buckets: int = _COUNTER_BUCKETS) -> List[dict]:
     return events
 
 
+def service_counter_events(recorder,
+                           buckets: int = _COUNTER_BUCKETS) -> List[dict]:
+    """Consult-service counter track (pid 0, tid 1): batching-window queue
+    depth and dispatched batch size over sim time, from the samples
+    ``collect_cluster`` pulled out of every engaged DeviceConsultService.
+    Bucketed to the same resolution as the cluster counter tracks."""
+    samples = getattr(recorder, "_service_samples", None)
+    if not samples:
+        return []
+    lo, hi = samples[0][0], samples[-1][0]
+    width = max((hi - lo) // max(buckets, 1), 1)
+    events: List[dict] = []
+    bucket_ts = None
+    depth_max = 0
+    rows_max = 0
+    for ts, depth, rows in samples:
+        b = lo + ((ts - lo) // width) * width
+        if bucket_ts is None:
+            bucket_ts = b
+        if b != bucket_ts:
+            events.append({"name": "consult_service", "cat": "counter",
+                           "ph": "C", "ts": bucket_ts, "pid": COUNTER_PID,
+                           "tid": 1, "args": {"queue_depth": depth_max,
+                                              "batch_rows": rows_max}})
+            bucket_ts, depth_max, rows_max = b, 0, 0
+        depth_max = max(depth_max, depth)
+        rows_max = max(rows_max, rows)
+    events.append({"name": "consult_service", "cat": "counter", "ph": "C",
+                   "ts": bucket_ts, "pid": COUNTER_PID, "tid": 1,
+                   "args": {"queue_depth": depth_max,
+                            "batch_rows": rows_max}})
+    return events
+
+
 def _span_events(span) -> List[dict]:
     events: List[dict] = []
     tid_str = str(span.txn_id)
@@ -126,6 +160,11 @@ def chrome_trace(recorder, include_messages: bool = True) -> dict:
         pids.add(COUNTER_PID)
         tids.add((COUNTER_PID, 0))
         events.extend(counters)
+    svc_counters = service_counter_events(recorder)
+    if svc_counters:
+        pids.add(COUNTER_PID)
+        tids.add((COUNTER_PID, 1))
+        events.extend(svc_counters)
     if include_messages:
         for seq, ts, event, frm, to, msg_id, brief in recorder.messages:
             pids.add(frm)
@@ -142,7 +181,7 @@ def chrome_trace(recorder, include_messages: bool = True) -> dict:
                      "tid": 0, "args": {"name": pname}})
     for pid, tid in sorted(tids):
         if pid == COUNTER_PID:
-            name = "counters"
+            name = "counters" if tid == 0 else "consult service"
         else:
             name = "coordinator" if tid == 0 else f"store {tid - 1}"
         meta.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,

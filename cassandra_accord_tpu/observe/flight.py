@@ -49,6 +49,10 @@ class FlightRecorder:
         # counter tracks sample these into per-bucket "C" events)
         self._recovery_times: list = []
         self._invalidate_times: list = []
+        # consult-service (ts, queue_depth, batch_rows) samples, pulled from
+        # every engaged DeviceConsultService at collect_cluster time — the
+        # export renders them as a dedicated counter track (pid 0, tid 1)
+        self._service_samples: list = []
 
     @property
     def messages(self):
@@ -178,6 +182,11 @@ class FlightRecorder:
                 reg.gauge("store.tfk_inversions", node=node.id,
                           store=cs.id).set(cs.tfk_inversions)
         device_metrics.collect_into(reg, cluster)
+        samples: list = []
+        for _node_id, _store_id, svc in device_metrics.cluster_services(cluster):
+            samples.extend(svc.samples)
+        samples.sort()
+        self._service_samples = samples
 
     # -- rendering -----------------------------------------------------------
     def metrics_snapshot(self, cluster=None) -> dict:

@@ -61,13 +61,18 @@ class Histogram:
         self.total = 0
 
     def record(self, value: int) -> None:
-        self.count += 1
-        self.total += value
+        self.record_many(value, 1)
+
+    def record_many(self, value: int, n: int) -> None:
+        """Record ``value`` n times in O(1) (bulk collectors: exact totals
+        without a per-record loop)."""
+        self.count += n
+        self.total += value * n
         for i, bound in enumerate(self.bounds):
             if value <= bound:
-                self.counts[i] += 1
+                self.counts[i] += n
                 return
-        self.counts[-1] += 1
+        self.counts[-1] += n
 
     def to_snapshot(self) -> dict:
         return {"count": self.count, "total": self.total,

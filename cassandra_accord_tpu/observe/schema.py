@@ -113,8 +113,31 @@ LATENCY_METRIC = "txn.latency_us"
 
 RESOLVER_COUNTERS = ("prefetch_hits", "prefetch_patched", "prefetch_misses",
                      "walk_consults", "host_consults", "native_consults",
-                     "device_consults")
+                     "device_consults", "service_submitted", "service_batches")
 RESOLVER_METRICS = {c: f"resolver.{c}" for c in RESOLVER_COUNTERS}
+
+# -- persistent batched device consult service (device_service/) -------------
+# per-store gauges collected from DeviceConsultService.stats(); the
+# batch-size distribution additionally lands in a sim-registry histogram and
+# the queue-depth/batch-rows samples become Chrome-trace counter tracks
+SERVICE_STAT_METRICS = {
+    "submitted": "service.submitted",
+    "answered": "service.answered",
+    "oneshot_rows": "service.oneshot_rows",
+    "batches": "service.batches",
+    "dropped_windows": "service.dropped_windows",
+    # NOTE: dispatch_mean_s/dispatch_max_s (wall-clock) stay OUT of the
+    # registry on purpose — snapshots are diffed across same-seed runs and
+    # must not carry always-differing wall-clock floats; the bench and the
+    # replay harness read them from DeviceConsultService.stats() directly
+    "mean_batch_rows": "service.mean_batch_rows",
+    "window_occupancy": "service.window_occupancy",
+    "jit_shapes": "service.jit_shapes",
+    "index_full_uploads": "service.index_full_uploads",
+    "index_incremental_refreshes": "service.index_incremental_refreshes",
+    "index_rows_uploaded": "service.index_rows_uploaded",
+}
+SERVICE_BATCH_SIZE_METRIC = "service.batch_size"
 
 
 def metric_for_message(type_name: str) -> str:

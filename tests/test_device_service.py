@@ -1,0 +1,389 @@
+"""Persistent batched device consult service (cassandra_accord_tpu/device_service/).
+
+Covers the ISSUE-6 contracts:
+
+- ragged batch ingress (flat keys + row offsets): empty rows, duplicate
+  keys, max-width rows — batched answers equal per-txn host answers;
+- jit-shape discipline: a steady-state stream triggers a BOUNDED number of
+  kernel compilations (pow2 bucket shapes; second half of the stream
+  compiles nothing new);
+- double-buffered snapshot semantics: an open window answers against the
+  index as of the window's opening, while one-shot consults see the
+  current index;
+- counter bookkeeping: ``device_consults`` increments exactly once per
+  SUBMITTED consult, not per batch/launch;
+- zero observer effect: enabling the service under the hostile burn leaves
+  same-seed runs byte-identical (deterministic fallback and kernel backend
+  both).
+"""
+import numpy as np
+import pytest
+
+from cassandra_accord_tpu.device_service.batch import (build_batch,
+                                                       pow2_bucket,
+                                                       split_rows)
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+from cassandra_accord_tpu.impl.resolver import CpuDepsResolver
+from cassandra_accord_tpu.impl.tpu_resolver import TpuDepsResolver
+from cassandra_accord_tpu.local.cfk import InternalStatus
+from cassandra_accord_tpu.primitives.keys import IntKey
+from cassandra_accord_tpu.primitives.timestamp import (Domain, Timestamp,
+                                                       TxnId, TxnKind)
+from cassandra_accord_tpu.utils.random import RandomSource
+
+from tests.test_resolver import _FakeStore, k, rk, tid
+
+
+def make_service_resolver(txn_capacity=64, key_capacity=64, backend="jax"):
+    """A TpuDepsResolver forced onto the service device tier (jax runs on
+    the CPU backend under tier-1; that IS the kernel tier) + the cfk-walk
+    oracle on the same store."""
+    from cassandra_accord_tpu.config import LocalConfig
+    store = _FakeStore()
+    cfg = LocalConfig.from_env(tpu_service="on", tpu_service_backend=backend,
+                               tpu_tier="device")
+    r = TpuDepsResolver(store, txn_capacity=txn_capacity,
+                        key_capacity=key_capacity, config=cfg)
+    r.tier = "device"
+    return store, r, CpuDepsResolver(store)
+
+
+def register_both(store, resolver, txn_id, status, execute_at, keys):
+    indexed = tuple(key for key in keys
+                    if store.cfk(key).update(txn_id, status, execute_at))
+    if indexed:
+        resolver.register(txn_id, status, execute_at, indexed)
+
+
+# ---------------------------------------------------------------------------
+# batch ingress contract
+# ---------------------------------------------------------------------------
+
+def test_pow2_buckets():
+    assert pow2_bucket(1, 8) == 8
+    assert pow2_bucket(8, 8) == 8
+    assert pow2_bucket(9, 8) == 16
+    assert pow2_bucket(300, 8, 256) == 256
+    assert split_rows(list(range(10)), 4) == [[0, 1, 2, 3], [4, 5, 6, 7],
+                                              [8, 9]]
+    assert split_rows([], 4) == []
+
+
+def test_ragged_batch_shapes_and_densify():
+    rows = [(0, 1), (), (2, 2, 2), tuple(range(7))]   # empty + dups + wide
+    b = build_batch(rows, [(1, 0, 0, 0, 0)] * 4, [0] * 4)
+    assert b.rows == 4
+    assert b.before.shape[0] == 8                     # row bucket floor
+    assert b.flat_cols.shape[0] == 16                 # flat bucket floor
+    assert b.offsets[1] - b.offsets[0] == 2
+    assert b.offsets[2] - b.offsets[1] == 0           # empty row
+    assert list(b.offsets[4:]) == [12] * 5            # padding rows width 0
+    q = b.densify(8)
+    assert q[0].tolist() == [1, 1, 0, 0, 0, 0, 0, 0]
+    assert q[1].sum() == 0
+    assert q[2].tolist() == [0, 0, 1, 0, 0, 0, 0, 0]  # dups collapse
+    assert q[3].sum() == 7
+
+
+def test_batch_over_cap_raises():
+    with pytest.raises(ValueError):
+        build_batch([(0,)] * 9, [(0,) * 5] * 9, [0] * 9, row_cap=8)
+
+
+# ---------------------------------------------------------------------------
+# ragged-batch correctness: batched service consults == per-txn host consults
+# ---------------------------------------------------------------------------
+
+def _random_index(store, resolver, rng, keys, n_txns=120):
+    hlc = 0
+    live = []
+    for _ in range(n_txns):
+        hlc += rng.next_int(1, 4)
+        kind = rng.pick([TxnKind.WRITE, TxnKind.READ, TxnKind.WRITE])
+        t = tid(hlc, node=1 + rng.next_int(3), kind=kind)
+        ks = sorted({rng.pick(keys) for _ in range(rng.next_int(1, 5))})
+        register_both(store, resolver, t, InternalStatus.PREACCEPTED, None, ks)
+        live.append((t, ks))
+        if live and rng.next_float() < 0.4:
+            t2, ks2 = rng.pick(live)
+            st = rng.pick([InternalStatus.ACCEPTED, InternalStatus.COMMITTED,
+                           InternalStatus.STABLE, InternalStatus.APPLIED])
+            ea = Timestamp(1, hlc + rng.next_int(10), 0, t2.node) \
+                if st in (InternalStatus.ACCEPTED, InternalStatus.COMMITTED,
+                          InternalStatus.STABLE) else None
+            register_both(store, resolver, t2, st, ea, ks2)
+    return hlc
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_ragged_property_batched_equals_per_txn(seed):
+    """Randomized ragged windows (empty key sets, duplicate keys, max-width
+    rows) through the production prefetch→futures path must equal the
+    per-txn cfk walk, query for query (the resolver's elision gate routes
+    below-covering-bound rows to the exact path, exactly as live traffic)."""
+    from cassandra_accord_tpu.impl.resolver import QuerySpec
+    rng = RandomSource(seed)
+    store, resolver, oracle = make_service_resolver()
+    keys = [rk(i * 10) for i in range(10)]
+    hlc = _random_index(store, resolver, rng, keys)
+    svc = resolver.service()
+    windows = 0
+    for _round in range(8):
+        hlc += 1
+        specs = []
+        queries = []
+        for _q in range(rng.next_int(1, 9)):
+            hlc += 1
+            q = tid(hlc, kind=rng.pick([TxnKind.WRITE, TxnKind.READ]))
+            width = rng.pick([0, 1, 2, len(keys)])    # empty + max-width rows
+            qk = [rng.pick(keys) for _ in range(width)]
+            if qk and rng.next_boolean():
+                qk = qk + [qk[0]]                     # duplicate keys
+            before = q.as_timestamp()
+            specs.append(QuerySpec("kc", q, qk, before))
+            if rng.next_boolean():
+                specs.append(QuerySpec("mc", None, qk, None))
+            queries.append((q, qk, before))
+        resolver.prefetch(specs)
+        windows += 1
+        for q, qk, before in queries:
+            got = resolver.key_conflicts(q, qk, before)
+            # set-level comparison: batched attribution is per (key, txn)
+            # incidence over the DEDUPED key set
+            expect = oracle.key_conflicts(q, sorted(set(qk)), before)
+            assert sorted(set(got)) == sorted(set(expect))
+            assert resolver.max_conflict_keys(qk) \
+                == oracle.max_conflict_keys(sorted(set(qk)))
+        resolver.end_batch()
+    assert resolver.device_consults > 0
+    assert svc.submitted > 0, "prefetch must route through the service"
+
+
+def test_oneshot_consult_matches_walk_oracle():
+    """The immediate (non-window) service path: key_conflicts/max_conflict
+    through consult_rows vs the cfk walk, including after prunes."""
+    rng = RandomSource(77)
+    store, resolver, oracle = make_service_resolver()
+    keys = [rk(i * 10) for i in range(8)]
+    hlc = _random_index(store, resolver, rng, keys, n_txns=80)
+    for key in keys[:3]:
+        cfk = store.cfks.get(key)
+        if cfk is not None:
+            resolver.on_pruned(key, cfk.prune_applied_before(tid(hlc + 1)))
+    for _ in range(30):
+        hlc += 2
+        q = tid(hlc, kind=rng.pick([TxnKind.WRITE, TxnKind.READ]))
+        qk = sorted({rng.pick(keys) for _ in range(rng.next_int(1, 5))})
+        assert sorted(resolver.key_conflicts(q, qk, q.as_timestamp())) \
+            == sorted(oracle.key_conflicts(q, qk, q.as_timestamp()))
+        assert resolver.max_conflict_keys(qk) == oracle.max_conflict_keys(qk)
+    assert resolver.device_consults > 0
+
+
+# ---------------------------------------------------------------------------
+# double-buffered snapshot semantics
+# ---------------------------------------------------------------------------
+
+def test_window_answers_against_pinned_snapshot():
+    """A window pins the index as of begin_window: a registration landing
+    mid-window must not appear in the window's deferred answers, while a
+    fresh one-shot consult (current index) must see it."""
+    store, resolver, oracle = make_service_resolver()
+    key = rk(10)
+    register_both(store, resolver, tid(10), InternalStatus.PREACCEPTED,
+                  None, [key])
+    svc = resolver.service()
+    svc.begin_window()
+    q = tid(100)
+    fut = svc.submit([resolver.key_slot[key]], q.as_timestamp().pack_lanes(),
+                     int(q.kind), post=resolver._post_kc([key]))
+    # mid-window registration (a NEW txn on the same key)
+    register_both(store, resolver, tid(50), InternalStatus.PREACCEPTED,
+                  None, [key])
+    got = {t for _k, t in fut.result()}
+    assert got == {tid(10)}, "snapshot window must not see mid-window txns"
+    svc.end_window()
+    # one-shot consult sees the current index
+    now = {t for _k, t in resolver.key_conflicts(tid(101), [key],
+                                                 tid(101).as_timestamp())}
+    assert now == {tid(10), tid(50)}
+    assert svc.index.incremental_refreshes + svc.index.full_uploads >= 2
+
+
+def test_incremental_refresh_not_full_reupload():
+    """Steady mutation + consult interleave must refresh by rows, not by
+    whole-index re-upload (the r05 wedge shape)."""
+    store, resolver, _ = make_service_resolver(txn_capacity=256,
+                                               key_capacity=64)
+    keys = [rk(i * 10) for i in range(8)]
+    # warm: fill past the first view tier, one consult to establish buffers
+    for i in range(80):
+        register_both(store, resolver, tid(10 + i, node=1 + i % 3),
+                      InternalStatus.PREACCEPTED, None,
+                      [keys[i % len(keys)]])
+    resolver.key_conflicts(tid(500), keys[:2], tid(500).as_timestamp())
+    svc = resolver.service()
+    full_before = svc.index.full_uploads
+    for i in range(40):
+        register_both(store, resolver, tid(1000 + i, node=1 + i % 3),
+                      InternalStatus.PREACCEPTED, None,
+                      [keys[i % len(keys)]])
+        resolver.key_conflicts(tid(2000 + i), [keys[i % len(keys)]],
+                               tid(2000 + i).as_timestamp())
+    assert svc.index.incremental_refreshes >= 30
+    assert svc.index.full_uploads == full_before, \
+        "steady-state consults must not re-upload the whole index"
+
+
+# ---------------------------------------------------------------------------
+# jit-shape discipline (bounded compilations in steady state)
+# ---------------------------------------------------------------------------
+
+def test_steady_state_compilations_bounded():
+    """Replaying a steady-state stream of varying window sizes compiles a
+    BOUNDED kernel set: shapes appear while buckets/views warm up, then the
+    second half of the stream adds ZERO new shapes."""
+    rng = RandomSource(5)
+    store, resolver, _ = make_service_resolver(txn_capacity=256,
+                                               key_capacity=64)
+    keys = [rk(i * 10) for i in range(8)]
+    _random_index(store, resolver, rng, keys, n_txns=100)
+    svc = resolver.service()
+
+    hlc_box = [10_000]
+
+    def drive(rounds):
+        # deterministic cycle of window sizes and row widths: both halves of
+        # the stream exercise the SAME shape mix, so steady state is exact
+        sizes = [1, 3, 8, 12]
+        widths = [0, 1, 2, 3]
+        for r in range(rounds):
+            svc.begin_window()
+            futs = []
+            for q_i in range(sizes[r % len(sizes)]):
+                hlc_box[0] += 1
+                q = tid(hlc_box[0])
+                qk = [keys[(q_i + j) % len(keys)]
+                      for j in range(widths[(r + q_i) % len(widths)])]
+                known = [x for x in qk if x in resolver.key_slot]
+                cols = [resolver.key_slot[x] for x in known]
+                futs.append(svc.submit(cols, q.as_timestamp().pack_lanes(),
+                                       int(q.kind),
+                                       post=resolver._post_kc(known)))
+            for f in futs:
+                f.result()
+            svc.end_window()
+
+    drive(20)
+    shapes_mid = set(svc.jit_shapes) | set(svc.index.jit_shapes)
+    drive(20)
+    shapes_end = set(svc.jit_shapes) | set(svc.index.jit_shapes)
+    assert shapes_end == shapes_mid, \
+        f"steady state must compile nothing new: {shapes_end - shapes_mid}"
+    # absolute bound: row buckets × flat buckets × view tiers stays small
+    assert len(shapes_end) <= 24, sorted(shapes_end)
+
+
+# ---------------------------------------------------------------------------
+# counter bookkeeping (one increment per SUBMITTED consult)
+# ---------------------------------------------------------------------------
+
+def test_device_consults_counted_per_consult_not_per_batch():
+    store, resolver, _ = make_service_resolver()
+    keys = [rk(i * 10) for i in range(6)]
+    for i in range(20):
+        register_both(store, resolver, tid(10 + i),
+                      InternalStatus.PREACCEPTED, None, [keys[i % 6]])
+    svc = resolver.service()
+    before_consults = resolver.device_consults
+    before_batches = svc.batches
+    svc.begin_window()
+    futs = [svc.submit([resolver.key_slot[keys[i % 6]]],
+                       tid(1000 + i).as_timestamp().pack_lanes(), 0,
+                       post=resolver._post_kc([keys[i % 6]]))
+            for i in range(10)]
+    futs[0].result()            # first demand dispatches the WHOLE window
+    svc.end_window()
+    assert resolver.device_consults - before_consults == 10, \
+        "device_consults must count submitted consults, not launches"
+    assert svc.batches - before_batches == 1
+    assert all(f.done for f in futs)
+
+
+def test_undemanded_window_costs_zero_launches():
+    store, resolver, _ = make_service_resolver()
+    key = rk(10)
+    register_both(store, resolver, tid(10), InternalStatus.PREACCEPTED,
+                  None, [key])
+    svc = resolver.service()
+    svc.begin_window()
+    svc.submit([resolver.key_slot[key]], tid(99).as_timestamp().pack_lanes(),
+               0, post=resolver._post_kc([key]))
+    batches = svc.batches
+    consults = resolver.device_consults
+    svc.end_window()            # never demanded
+    assert svc.batches == batches
+    assert resolver.device_consults == consults
+    assert svc.dropped_windows == 1
+
+
+# ---------------------------------------------------------------------------
+# burn-level byte-identity (zero observer effect of ENABLING the service)
+# ---------------------------------------------------------------------------
+
+HOSTILE = dict(ops=40, concurrency=8, chaos=True, allow_failures=True,
+               durability=True, journal=True, max_tasks=3_000_000)
+
+
+def _burn_trace(seed, **env_overrides):
+    from cassandra_accord_tpu.config import LocalConfig
+    # force the device tier so the service actually carries the consults
+    # (at burn-scale indexes the auto cost model keeps everything on the
+    # walk/host rungs — exactly the BENCH_r03 zero-consult shape)
+    cfg = LocalConfig.from_env(resolver_kind="tpu", tpu_tier="device",
+                               tpu_walk_max=0, tpu_walk_width=0,
+                               **env_overrides)
+    t = Trace()
+    res = run_burn(seed, tracer=t.hook, resolver="tpu", batch_window_us=5000,
+                   node_config=cfg, **HOSTILE)
+    return t, res
+
+
+def test_service_byte_identical_under_hostile_burn():
+    """Same-seed hostile burn with the service OFF vs ON (deterministic host
+    fallback): byte-identical message traces and outcomes — the service is a
+    pure data-plane substitution."""
+    ta, ra = _burn_trace(3, tpu_service="off")
+    tb, rb = _burn_trace(3, tpu_service="on", tpu_service_backend="host")
+    divergence = diff_traces(ta, tb)
+    assert divergence is None, f"service changed the simulation:\n{divergence}"
+    assert (ra.ops_ok, ra.ops_recovered, ra.ops_nacked, ra.ops_lost,
+            ra.ops_failed, ra.sim_micros) == \
+           (rb.ops_ok, rb.ops_recovered, rb.ops_nacked, rb.ops_lost,
+            rb.ops_failed, rb.sim_micros)
+
+
+def test_service_kernel_byte_identical_benign_burn():
+    """Benign-network burn, forced device tier: service jax path vs legacy
+    one-shot dispatch answer byte-identically (trace + outcomes)."""
+    from cassandra_accord_tpu.config import LocalConfig
+    base = dict(ops=30, concurrency=6, durability=True)
+    traces = []
+    results = []
+    for service in ("off", "on"):
+        cfg = LocalConfig.from_env(resolver_kind="tpu", tpu_tier="device",
+                                   tpu_service=service,
+                                   tpu_service_backend="jax",
+                                   tpu_walk_max=0, tpu_walk_width=0)
+        t = Trace()
+        results.append(run_burn(21, tracer=t.hook, resolver="tpu",
+                                batch_window_us=5000, node_config=cfg, **base))
+        traces.append(t)
+    divergence = diff_traces(*traces)
+    assert divergence is None, f"service kernel diverged:\n{divergence}"
+    a, b = results
+    assert (a.ops_ok, a.sim_micros) == (b.ops_ok, b.sim_micros)
+    # and the service actually carried consults on the protocol path
+    assert b.stats.get("resolver_device_consults", 0) > 0
+    assert b.stats.get("resolver_service_batches", 0) > 0

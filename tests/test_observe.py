@@ -248,7 +248,8 @@ def test_zero_observer_effect_hostile():
     # message stats identical too (tier-choice counters are wall-clock
     # driven and excluded from the determinism contract, as in reconcile)
     tier_keys = ("resolver_host_consults", "resolver_native_consults",
-                 "resolver_device_consults")
+                 "resolver_device_consults", "resolver_service_submitted",
+                 "resolver_service_batches")
     sa = {k: v for k, v in bare.stats.items() if k not in tier_keys}
     sb = {k: v for k, v in observed.stats.items() if k not in tier_keys}
     assert sa == sb
