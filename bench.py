@@ -546,6 +546,71 @@ def main():
         }
     stage("protocol", proto)
 
+    def protocol_slo():
+        # latency-SLO workload judged by the flight-recorder/auditor plane
+        # (ROADMAP item 5): p50/p95/p99 commit latency from the recorder's
+        # sim-time histogram plus liveness-SLO flag counts, at 5 and 15
+        # nodes under the ELASTIC matrix (join/decommission under load).
+        # Sim-time latencies: deterministic, workload-intrinsic — wall clock
+        # never enters the percentile math.
+        from dataclasses import replace as _replace
+        from cassandra_accord_tpu.config import LocalConfig
+        from cassandra_accord_tpu.harness.burn import run_burn
+        from cassandra_accord_tpu.observe import InvariantAuditor
+        from cassandra_accord_tpu.observe import schema as _schema
+
+        def pct(snapshot, q):
+            """Percentile estimate from a fixed-bound histogram: upper bound
+            of the bucket containing the q-quantile (conservative)."""
+            total = snapshot["count"]
+            if not total:
+                return None
+            need = q * total
+            acc = 0
+            bounds = snapshot["bounds"]
+            for i, n in enumerate(snapshot["buckets"]):
+                acc += n
+                if acc >= need:
+                    return bounds[i] if i < len(bounds) else None
+            return None
+
+        out = {}
+        cfg = _replace(LocalConfig(), membership_interval_s=6.0)
+        for n_nodes in (5, 15):
+            auditor = InvariantAuditor(mode="warn")
+            t0 = time.perf_counter()
+            res = run_burn(seed=PROTO_SEED, ops=200, concurrency=PROTO_CONC,
+                           nodes=n_nodes, rf=5 if n_nodes >= 5 else 3,
+                           chaos=True, allow_failures=True,
+                           topology_churn=True, elastic_membership=True,
+                           durability=True, journal=True, node_config=cfg,
+                           observer=auditor, audit="warn",
+                           stall_watchdog_s=300.0, max_tasks=80_000_000)
+            dt = time.perf_counter() - t0
+            hist = auditor.registry.histogram(
+                _schema.LATENCY_METRIC).to_snapshot()
+            verdict = res.audit or {}
+            out[f"nodes_{n_nodes}"] = {
+                "ops": res.resolved,
+                "joins": res.joins, "leaves": res.leaves,
+                "commits_per_sec_wall": round(res.resolved / dt, 1)
+                if dt else None,
+                "commit_latency_us": {
+                    "p50": pct(hist, 0.50), "p95": pct(hist, 0.95),
+                    "p99": pct(hist, 0.99), "count": hist["count"],
+                    "mean": round(hist["total"] / hist["count"])
+                    if hist["count"] else None},
+                "slo_flags": {
+                    "raised": verdict.get("slo_flags_raised"),
+                    "open_at_quiesce": verdict.get("slo_flags_open")},
+                "violations": verdict.get("violations"),
+            }
+        return out
+
+    ps = stage("protocol_slo", protocol_slo)
+    if ps is not None:
+        d["protocol_slo"] = ps
+
     def frontier():
         # frontier-driven execution in the flagship configuration
         from cassandra_accord_tpu.harness.burn import run_burn

@@ -40,6 +40,23 @@ class LocalConfig:
     slow_read_threshold_s: float = 1.5
     investigation_stagger_s: float = 0.5    # progress-log launch stagger window
 
+    # -- re-fencing cooperation (the seed-6 wedge) ---------------------------
+    # a txn decided (stable-or-later) this many sim-seconds ago with no local
+    # apply counts as "unapplied pressure" (the slo.unapplied condition); the
+    # bootstrap retry ladder and staleness catch-up escalation stretch their
+    # cadence by the pressure count, capped, so re-fencing never outruns
+    # in-flight partial-read coverage assembly
+    refence_pressure_age_s: float = 10.0
+    refence_backoff_max_s: float = 30.0
+
+    # -- elastic membership (harness/nemesis.py MembershipNemesis) -----------
+    # mean sim-time between join/decommission attempts (jittered, de-aligned
+    # from the other nemesis cadences); member-count bounds are derived from
+    # the initial cluster size unless set
+    membership_interval_s: float = 25.0
+    membership_min_members: Optional[int] = None
+    membership_max_members: Optional[int] = None
+
     # -- crash-restart nemesis (harness/nemesis.py) --------------------------
     # mean sim-time between crash attempts; each tick is jittered so crashes
     # never align with the chaos re-roll cadence
@@ -138,6 +155,7 @@ class LocalConfig:
         ("ACCORD_PAUSE_INTERVAL", "pause_interval_s", float),
         ("ACCORD_PAUSE_MAX", "pause_max_s", float),
         ("ACCORD_DISK_STALL_INTERVAL", "disk_stall_interval_s", float),
+        ("ACCORD_MEMBERSHIP_INTERVAL", "membership_interval_s", float),
         ("ACCORD_JOURNAL_CORRUPTION", "journal_corruption_policy",
          lambda v: v.lower()),
         ("ACCORD_JOURNAL_TORN_TAIL_CHANCE", "journal_torn_tail_chance", float),

@@ -278,6 +278,10 @@ class _ExecuteTxn:
         self.read_rounds = 0
         self._read_retry_pending = False
         self._init_unread()
+        # grandfathered-coverage accounting (the seed-6 refencing wedge):
+        # residue snapshot at the last retry-round launch — a round that
+        # strictly SHRANK the residue doesn't charge the round budget
+        self._last_residue = None
 
     MAX_READ_ROUNDS = 3
 
@@ -295,6 +299,19 @@ class _ExecuteTxn:
                 if ks:
                     self._unread[i] = ks
 
+    def _residue_snapshot(self):
+        """Canonical snapshot of the per-shard unread residues.  Coverage is
+        monotone (``absorb_partial`` only intersects), so inequality with the
+        previous round's snapshot means the residue strictly shrank."""
+        out = []
+        for i in sorted(self._unread):
+            cur = self._unread[i]
+            if isinstance(cur, set):
+                out.append((i, tuple(sorted(cur))))
+            else:
+                out.append((i, tuple((r.start, r.end) for r in cur)))
+        return tuple(out)
+
     def retry_read_round_or_fail(self) -> None:
         """A read round exhausted on TRANSIENT nacks (obsolete: the copy is
         mid-apply and will serve from the MVCC snapshot once APPLIED;
@@ -310,6 +327,17 @@ class _ExecuteTxn:
         cfg = getattr(self.node, "config", None)
         max_rounds = cfg.max_read_rounds if cfg is not None \
             else self.MAX_READ_ROUNDS
+        snap = self._residue_snapshot()
+        if self._last_residue is not None and snap != self._last_residue:
+            # the last round strictly shrank the unread residue: coverage IS
+            # assembling, so the round is progress, not a failure — only
+            # NO-PROGRESS rounds charge the budget (the residue is monotone
+            # non-increasing over a finite set of reply boundaries, so this
+            # still terminates).  Without it, the round budget raced the
+            # truncation/staleness ladder's re-fencing cadence and a read
+            # gathering one new slice per round still exhausted (seed 6).
+            self.read_rounds = 0
+        self._last_residue = snap
         if self.read_rounds >= max_rounds:
             # NOTE: rounds exhausted partly by hard (link FAILURE) replies
             # still retry — in the chaos model link failures are transient
@@ -328,8 +356,28 @@ class _ExecuteTxn:
             if self.done:
                 return
             from ..topology.topology import Topologies
+            # GRANDFATHER the assembled coverage (the seed-6 refencing
+            # wedge): slices already served are FINAL — the read is at a
+            # fixed executeAt and the data store is an immutable MVCC
+            # snapshot there — so the union built in earlier rounds
+            # survives into this one.  Resetting it each round raced
+            # coverage assembly against the truncation/staleness ladder's
+            # re-fencing cadence: every round restarted from zero while a
+            # fresh catch-up fence kept SOME slice pending somewhere, and
+            # the budget exhausted into Exhausted(read) -> recovery churn.
+            prev_unread = self._unread
             self.read_tracker = ReadTracker(Topologies([self.topologies.current()]))
             self._init_unread()
+            for i in list(self._unread):
+                if i in prev_unread:
+                    self._unread[i] = prev_unread[i]
+            # shards the union already covers need no further reads: mark
+            # them read so neither contacts nor candidate exhaustion is
+            # burned on them (their data was banked in an earlier round)
+            for i, t in enumerate(self.read_tracker.trackers):
+                cur = self._unread.get(i)
+                if cur is None or not len(cur):
+                    t.data_received = True
             # rotate EVERY shard's pick per round: re-contacting the same
             # (deterministically chosen) stuck copy every round re-creates
             # the livelock the rounds exist to break

@@ -402,9 +402,24 @@ def adopt_erased(node: "Node", txn_id: TxnId, route: Route) -> None:
         # unreachable) waiters must still unblock, and the conservative heal
         # below keeps reads redirected until the data plane is whole again
         def for_store(safe_store) -> None:
+            from ..local.status import SaveStatus as _SS
+            probe = safe_store.get_if_exists(txn_id)
+            if (probe is None or not probe.listeners) \
+                    and C._is_shard_redundant(safe_store, txn_id, route):
+                # GC physically erased this txn below the shard fence: do
+                # not resurrect a fresh stub just to mark it ERASED (ballot
+                # regression; the fend-off shared with accept/propagate) —
+                # unless a local waiter still lists it (listeners), in
+                # which case the truncation below is exactly what unblocks
+                # the waiter
+                return
             cmd = safe_store.get_if_exists(txn_id)
             if cmd is None or cmd.save_status.is_truncated \
+                    or cmd.save_status is _SS.INVALIDATED \
                     or cmd.has_been(Status.PRE_COMMITTED):
+                # an INVALIDATED tombstone already unblocks waiters and must
+                # persist AS INVALIDATED until the shard fence (never
+                # downgrade to ERASED: the round-4 resurrection class)
                 return
             if txn_id.is_write:
                 cmd_route = cmd.route if cmd.route is not None else route

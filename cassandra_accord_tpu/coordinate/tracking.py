@@ -196,9 +196,16 @@ class ReadTracker(AbstractTracker):
         the pick shifts past them when ANY non-slow alternative exists, so a
         known-slow replica never costs a whole timeout/speculation round.
         When every replica of a shard is marked slow, the base pick stands —
-        avoidance must never starve a shard of its read."""
+        avoidance must never starve a shard of its read.
+
+        Shards already marked ``data_received`` are skipped: a retry round
+        with grandfathered partial-read coverage (coordinate_transaction)
+        pre-marks fully-covered shards, and re-reading them would burn
+        replies — or spurious exhaustion — on data already banked."""
         out: Set[int] = set()
         for t in self.trackers:
+            if t.data_received:
+                continue
             nodes = t.shard.nodes
             base = nodes.index(prefer) if prefer in nodes else 0
             pick = nodes[(base + rotate) % len(nodes)]

@@ -43,6 +43,8 @@ class BurnResult:
         self.restarts = 0        # journal-replay rebuilds
         self.pauses = 0          # stop-the-world process pauses
         self.disk_stalls = 0     # journal-append stalls
+        self.joins = 0           # elastic membership: nodes joined mid-burn
+        self.leaves = 0          # elastic membership: decommissions mid-burn
         self.sim_micros = 0
         self.stats: Dict[str, int] = {}
         self.audit: Optional[dict] = None   # InvariantAuditor verdict, if on
@@ -56,10 +58,13 @@ class BurnResult:
         restarts = f", restarts={self.restarts}" if self.restarts else ""
         pauses = f", pauses={self.pauses}" if self.pauses else ""
         stalls = f", disk_stalls={self.disk_stalls}" if self.disk_stalls else ""
+        joins = f", joins={self.joins}" if self.joins else ""
+        leaves = f", leaves={self.leaves}" if self.leaves else ""
         return (f"BurnResult(seed={self.seed}, ok={self.ops_ok}, "
                 f"recovered={self.ops_recovered}, nacked={self.ops_nacked}, "
                 f"lost={self.ops_lost}, failed={self.ops_failed}{restarts}"
-                f"{pauses}{stalls}, sim_ms={self.sim_micros // 1000})")
+                f"{pauses}{stalls}{joins}{leaves}, "
+                f"sim_ms={self.sim_micros // 1000})")
 
 
 class SimulationException(Exception):
@@ -123,6 +128,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              allow_failures: bool = False,
              topology_churn: bool = False,
              churn_interval_s: float = 1.0,
+             elastic_membership: bool = False,
              delayed_stores: bool = False,
              clock_drift: bool = False,
              journal: bool = False,
@@ -158,6 +164,14 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     from LocalConfig (``node_config`` or env) — including crash-time journal
     damage injection (torn tails, bit flips) the restart replay must detect
     and absorb.  Requires ``journal=True``.
+
+    ``elastic_membership=True`` adds the membership nemesis
+    (harness/nemesis.py MembershipNemesis): seeded join (a fresh process
+    spawned mid-run bootstraps its ranges from live peers) and decommission
+    (hand-off: removed from every shard in one epoch; the drained process
+    stays live for prior epochs) cycles, plus join/leave actions in the
+    topology-churn mutation mix — all respecting the muted-quorum floor and
+    the randomizer's per-range clean-readable-quorum floor.
 
     ``pause_nodes=True`` adds the pause nemesis: seeded stop-the-world
     process pauses; every frozen timer late-fires at resume.
@@ -257,13 +271,22 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     run_burn.last_cluster_ref = weakref.ref(cluster)
     member_ids = sorted(cluster.nodes)  # nodes actually replicating some shard
     churn_task = None
-    if topology_churn:
+    randomizer = None
+    # elastic membership: node ids the run may SPAWN mid-burn (joins beyond
+    # the initial member set); candidate set covers spawned nodes too
+    spawn_pool = list(range(n_nodes + 1, n_nodes + 1 + max(2, n_nodes // 2))) \
+        if elastic_membership else []
+    if topology_churn or elastic_membership:
         # random topology mutations at a fixed sim-time cadence
-        # (Cluster.java:461, TopologyRandomizer.maybeUpdateTopology)
+        # (Cluster.java:461, TopologyRandomizer.maybeUpdateTopology); with
+        # elastic membership the mutation mix grows join/leave actions
         from .topology_randomizer import TopologyRandomizer
-        randomizer = TopologyRandomizer(cluster, rng.fork())
-        churn_task = cluster.scheduler.recurring(churn_interval_s,
-                                                 randomizer.maybe_update_topology)
+        randomizer = TopologyRandomizer(cluster, rng.fork(),
+                                        elastic=elastic_membership,
+                                        spawn_pool=spawn_pool)
+        if topology_churn:
+            churn_task = cluster.scheduler.recurring(
+                churn_interval_s, randomizer.maybe_update_topology)
     durability_scheduling: Dict[int, object] = {}
     if durability:
         # scheduled durability + truncation running DURING the burn, with
@@ -282,8 +305,9 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         for node in cluster.nodes.values():
             start_durability(node)
         # a restarted node gets a fresh scheduling instance (the old one's
-        # timers died with its incarnation)
+        # timers died with its incarnation); a JOINED node gets one too
         cluster.on_restart_hooks.append(start_durability)
+        cluster.on_add_hooks.append(start_durability)
     cache_miss_task = None
     if cache_miss:
         # cache-miss injection (DelayedCommandStores.java:138-195 capability):
@@ -541,6 +565,15 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
 
             coordinator.coordinate(txn, txn_id=txn_id).add_listener(on_done)
 
+    membership_nemesis = None
+    if elastic_membership:
+        from .nemesis import MembershipNemesis
+        membership_nemesis = MembershipNemesis(
+            cluster, rng.fork(), randomizer,
+            interval_s=cfg.membership_interval_s,
+            min_members=cfg.membership_min_members,
+            max_members=cfg.membership_max_members)
+        membership_nemesis.attach()
     nemesis = None
     if restart_nodes:
         from .nemesis import RestartNemesis
@@ -626,6 +659,11 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             heartbeat_task.cancel()
         if churn_task is not None:
             churn_task.cancel()
+        if membership_nemesis is not None:
+            # stop join/leave scheduling; drained nodes stay live (prior
+            # epochs still need them; the agreement check judges the FINAL
+            # topology's replica sets)
+            membership_nemesis.stop()
         if pause_nemesis is not None:
             # resume every paused node BEFORE restarting downed ones: the
             # parked late-firing timers must drain into a full replica set
@@ -684,6 +722,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         result.restarts = cluster.stats.get("node_restarts", 0)
         result.pauses = cluster.stats.get("node_pauses", 0)
         result.disk_stalls = cluster.stats.get("journal_stalls", 0)
+        result.joins = cluster.stats.get("node_joins", 0)
+        result.leaves = cluster.stats.get("node_decommissions", 0)
         # per-key execution-register inversion diagnostic (TimestampsForKey):
         # surfaced in every burn's stats; MUST be 0 in benign runs (asserted
         # by test_timestamps_for_key) — growth under chaos pages the Agent
@@ -825,6 +865,17 @@ def main(argv=None) -> None:
                    help="disable topology churn (churn is part of the "
                         "default hostile matrix: the reference's hardest "
                         "regime mutates topology DURING partitions)")
+    p.add_argument("--elastic", action="store_true",
+                   help="elastic membership: seeded join (add_node + join "
+                        "epoch) and decommission (hand-off + removal from "
+                        "every shard) cycles under load, plus join/leave "
+                        "actions in the churn mix — all respecting the "
+                        "muted-quorum floor")
+    p.add_argument("--matrix", default=None, choices=["big"],
+                   help="'big' = the large-cluster elastic regime: 10-20 "
+                        "nodes (seeded), rf 3/5, elastic membership + the "
+                        "full gray-failure matrix.  Gated behind "
+                        "ACCORD_LONG_BURNS=1 (hours-class wall clock)")
     p.add_argument("--no-cache-miss", action="store_true")
     p.add_argument("--no-restart", action="store_true",
                    help="disable the crash-restart nemesis (node kills + "
@@ -900,6 +951,12 @@ def main(argv=None) -> None:
     if not args.no_watchdog:
         watchdog_s = args.watchdog_stall if args.watchdog_stall is not None \
             else cfg.stall_watchdog_after_s
+    if args.matrix == "big":
+        import os as _os
+        if "ACCORD_LONG_BURNS" not in _os.environ:
+            raise SystemExit("--matrix big is an hours-class run: set "
+                             "ACCORD_LONG_BURNS=1 to confirm")
+        args.elastic = True
     lo, _, hi = args.seeds.partition(":")
     seeds = range(int(lo), int(hi) + 1) if hi else [int(lo)]
     summaries: list = []
@@ -933,13 +990,26 @@ def main(argv=None) -> None:
     _FAULT_KEYS = ("node_crashes", "node_restarts", "node_pauses",
                    "journal_stalls", "journal_unsynced_lost",
                    "journal_injected_tears", "journal_injected_bitflips",
-                   "journal_torn_records", "journal_quarantined_txns")
+                   "journal_torn_records", "journal_quarantined_txns",
+                   "node_joins", "node_decommissions")
     for seed in seeds:
-        rf = args.rf if args.rf is not None else 2 + RandomSource(seed).next_int(8)
+        if args.matrix == "big":
+            # the large-cluster regime: 10-20 nodes, rf 3/5, seeded per seed
+            srng = RandomSource(seed)
+            rf = args.rf if args.rf is not None else srng.pick([3, 3, 5])
+            if args.nodes is None:
+                args_nodes = srng.next_int(10, 21)
+            else:
+                args_nodes = args.nodes
+        else:
+            rf = args.rf if args.rf is not None \
+                else 2 + RandomSource(seed).next_int(8)
+            args_nodes = args.nodes
         kw = dict(ops=args.ops, concurrency=args.concurrency, rf=rf,
-                  nodes=args.nodes, resolver=args.resolver,
+                  nodes=args_nodes, resolver=args.resolver,
                   chaos=not args.benign, allow_failures=not args.benign,
                   topology_churn=not args.no_churn,
+                  elastic_membership=bool(args.elastic),
                   durability=True, journal=True,
                   delayed_stores=not args.benign, clock_drift=not args.benign,
                   cache_miss=not args.no_cache_miss,

@@ -75,6 +75,18 @@ class RandomizedLinkConfig(LinkConfig):
         # per-run biased partition coin (Cluster.java:719 biasedUniformBools)
         self.partition_chance = rng.next_float()
         self.partitioned: frozenset = frozenset()
+        # ASYMMETRIC partitions (reference Cluster.java overrideLinks
+        # supports per-link asymmetry), behind the same per-run biased coin:
+        # - one-way cut: the minority side's links fail in ONE direction
+        #   only (it can hear but not be heard, or speak but not be heard
+        #   back — "deaf"/"mute" halves of a failing NIC);
+        # - bridge partial partition: two sides cannot reach each other
+        #   directly, but a bridge node talks to both (a half-healed
+        #   spanning link) — no side is fully cut off yet no quorum sees
+        #   the full membership.
+        self.asym_chance = rng.next_float()
+        self.partition_mode = "sym"      # sym | oneway_out | oneway_in | bridge
+        self.bridge: frozenset = frozenset()   # bridge node(s) for "bridge"
         self.overrides: Dict[Tuple[int, int], _LinkOverride] = {}
         self.healed = False
         self._nodes: List[int] = []
@@ -86,10 +98,19 @@ class RandomizedLinkConfig(LinkConfig):
         recurring task, Cluster.java:455-459), retaining the handle so
         ``heal`` can CANCEL it — the ``healed`` no-op guard alone left the
         reroll firing (and drawing rng) forever after quiesce."""
+        self._cluster = cluster
         self._nodes = sorted(cluster.nodes)
 
         def reroll():
             if not self.healed:
+                # refresh the node set each re-roll: elastic membership
+                # spawns processes mid-burn, and a snapshot taken at attach
+                # would leave every joiner permanently exempt from
+                # partitions and link faults (membership would not be a
+                # fault axis for the very nodes it adds).  Down nodes stay
+                # in the pool (they restart; and for non-elastic runs
+                # nodes|down is constant, so trajectories are unchanged)
+                self._nodes = sorted(set(cluster.nodes) | cluster.down)
                 self.randomize()
 
         self._task = cluster.scheduler.recurring(self.interval_s, reroll)
@@ -106,14 +127,26 @@ class RandomizedLinkConfig(LinkConfig):
     # -- the re-roll ----------------------------------------------------------
     def randomize(self) -> None:
         rng = self.rng
-        # partition: minority side cut off (Cluster.java:615-622)
+        # partition: minority side cut off (Cluster.java:615-622), with the
+        # asymmetric variants behind their own per-run biased coin
         self.partitioned = frozenset()
+        self.partition_mode = "sym"
+        self.bridge = frozenset()
         if self._nodes and rng.next_float() < self.partition_chance:
             size = rng.next_int((self.rf + 1) // 2)
             if size > 0:
                 picks = list(self._nodes)
                 rng.shuffle(picks)
                 self.partitioned = frozenset(picks[:size])
+                if rng.next_float() < self.asym_chance:
+                    self.partition_mode = rng.pick(
+                        ["oneway_out", "oneway_in", "bridge"])
+                    if self.partition_mode == "bridge":
+                        rest = [n for n in picks[size:]]
+                        if rest:
+                            self.bridge = frozenset(rest[:1])
+                        else:
+                            self.partition_mode = "sym"
         # link overrides (Cluster.java:714-741)
         self.overrides = {}
         kind = rng.pick(list(self.KINDS))
@@ -151,11 +184,34 @@ class RandomizedLinkConfig(LinkConfig):
             latency_range = (lo, hi)
         return _LinkOverride(rng.fork(), weights, latency_range)
 
+    def _partition_drops(self, from_node: int, to_node: int) -> bool:
+        """Does the current partition cut this directed link?
+
+        - ``sym``: any link crossing the minority boundary drops (both
+          directions — the classic clean partition);
+        - ``oneway_out``: only packets FROM the minority drop (it hears the
+          world but cannot be heard — mute);
+        - ``oneway_in``: only packets TO the minority drop (it speaks but
+          hears nothing back — deaf);
+        - ``bridge``: links crossing the boundary drop UNLESS either
+          endpoint is the bridge node, which talks to both sides."""
+        crossing = (from_node in self.partitioned) != (to_node in self.partitioned)
+        if not crossing:
+            return False
+        mode = self.partition_mode
+        if mode == "oneway_out":
+            return from_node in self.partitioned
+        if mode == "oneway_in":
+            return to_node in self.partitioned
+        if mode == "bridge":
+            return from_node not in self.bridge and to_node not in self.bridge
+        return True
+
     # -- LinkConfig interface -------------------------------------------------
     def action(self, from_node: int, to_node: int, message=None) -> str:
         if self.healed:
             return LinkConfig.DELIVER
-        if (from_node in self.partitioned) != (to_node in self.partitioned):
+        if self._partition_drops(from_node, to_node):
             return LinkConfig.DROP
         override = self.overrides.get((from_node, to_node))
         if override is not None:

@@ -18,7 +18,7 @@ from ..impl.list_store import ListStore
 from ..local.node import Node
 from ..messages.base import Callback, FailureReply, Reply, Request
 from ..primitives.timestamp import Timestamp
-from ..topology.topology import Topology
+from ..topology.topology import Shard, Topology
 from ..utils import async_ as au
 from ..utils.random import RandomSource
 from ..coordinate.errors import Timeout
@@ -701,8 +701,15 @@ class Cluster:
         self.agent = SimAgent(self)
         self._num_shards = num_shards
         self._delayed_stores = delayed_stores
+        self._clock_drift = clock_drift
         self._resolver = resolver
         self._node_config = node_config
+        # elastic-membership lifecycle: nodes drained out of every shard by
+        # ``decommission`` (still live, serving prior epochs until they
+        # retire) and hooks fired with each freshly-added Node (the burn
+        # re-applies per-node wiring, like on_restart_hooks)
+        self.decommissioned: set = set()
+        self.on_add_hooks: List[Callable] = []
         # per-node clock drift (FrequentLargeRange nowSupplier, BurnTest:329-339)
         self.clock_offsets: Dict[int, int] = {}
         for node_id in sorted(set(topology.nodes()) | set(extra_nodes or ())):
@@ -1055,6 +1062,101 @@ class Cluster:
             self.clock_offsets[node_id] = max(0, off)
 
         self.scheduler.recurring(0.05, jump)
+
+    # -- elastic membership (join / decommission) -----------------------------
+    def add_node(self, node_id: int) -> Node:
+        """Spin up a brand-new process mid-run: fresh (empty) data store,
+        fresh Node initialised at the CURRENT epoch.  The node owns nothing
+        until a topology change gives it shards — its adoption diff then
+        runs the normal bootstrap ladder (fence sync point + data fetch)
+        against the live peers, exactly like any freshly-adopted range.
+        Joining is therefore ``add_node`` + a join epoch (TopologyRandomizer
+        ``join`` / MembershipNemesis), never a special data path."""
+        assert node_id not in self.nodes and node_id not in self.down, \
+            f"node {node_id} already exists"
+        self.stores[node_id] = ListStore(node_id)
+        node = self._make_node(node_id)
+        self.nodes[node_id] = node
+        if self._clock_drift:
+            self._start_drift(node_id)
+        if self.journal is not None:
+            for store in node.command_stores.all_stores():
+                self.journal.attach(store)
+        self.decommissioned.discard(node_id)
+        for hook in list(self.on_add_hooks):
+            hook(node)
+        self._count("node_joins")
+        return node
+
+    def decommission(self, node_id: int,
+                     choose_replacement: Optional[Callable] = None) -> Optional[Topology]:
+        """Remove ``node_id`` from EVERY shard of the latest topology in one
+        new epoch (the hand-off): each vacated slot is filled by a live
+        member (``choose_replacement(shard, candidates) -> node`` overrides
+        the default least-loaded pick).  NOTE: this manual API applies NO
+        clean-readable-quorum floor — the seeded schedules
+        (TopologyRandomizer._leave / MembershipNemesis) layer that check on
+        top; a direct caller draining a node whose shards are already
+        mid-bootstrap elsewhere is asking for expected unavailability.
+        The process stays LIVE — it keeps
+        serving prior-epoch reads, recovery evidence and bootstrap fetches
+        until those epochs retire; the new replicas bootstrap their adopted
+        ranges from it and its peers through the normal ladder.  Returns the
+        new topology, or None when some shard has no replacement candidate
+        (every live node already replicates it)."""
+        current = self.topologies[-1]
+        if not current.contains_node(node_id):
+            self.decommissioned.add(node_id)
+            return None   # already out of every shard: just mark drained
+        new_shards = self.plan_handoff(
+            list(current.shards), node_id,
+            candidate_pool=[n for n in sorted(self.nodes)
+                            if n != node_id and n not in self.down
+                            and n not in self.decommissioned],
+            choose_replacement=choose_replacement)
+        if new_shards is None:
+            return None
+        topology = Topology(current.epoch + 1, new_shards)
+        self.update_topology(topology)
+        self.decommissioned.add(node_id)
+        self._count("node_decommissions")
+        return topology
+
+    def plan_handoff(self, shards: List[Shard], leaver: int,
+                     candidate_pool: List[int],
+                     choose_replacement: Optional[Callable] = None,
+                     shard_ok: Optional[Callable] = None) -> Optional[List[Shard]]:
+        """The shared hand-off planner behind ``decommission`` and the
+        randomizer's ``leave`` mutation: replace ``leaver`` in every shard
+        with a candidate (``choose_replacement(shard, candidates)``
+        overrides the default least-loaded pick), optionally vetoing each
+        substituted shard via ``shard_ok(new_shard, pick)`` (the
+        randomizer's clean-readable-quorum floor).  Returns the full new
+        shard list, or None when any shard has no acceptable candidate —
+        the plan is all-or-nothing."""
+        load: Dict[int, int] = {}
+        for shard in shards:
+            for n in shard.nodes:
+                load[n] = load.get(n, 0) + 1
+        out: List[Shard] = []
+        for shard in shards:
+            if leaver not in shard.nodes:
+                out.append(shard)
+                continue
+            candidates = [n for n in candidate_pool if n not in shard.nodes]
+            if not candidates:
+                return None
+            if choose_replacement is not None:
+                pick = choose_replacement(shard, candidates)
+            else:
+                pick = min(candidates, key=lambda n: (load.get(n, 0), n))
+            new_shard = Shard(shard.range,
+                              [pick if n == leaver else n for n in shard.nodes])
+            if shard_ok is not None and not shard_ok(new_shard, pick):
+                return None
+            load[pick] = load.get(pick, 0) + 1
+            out.append(new_shard)
+        return out
 
     # -- topology change -----------------------------------------------------
     def update_topology(self, new_topology: Topology) -> None:

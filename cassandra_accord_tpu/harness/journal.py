@@ -37,7 +37,11 @@ from ..primitives.timestamp import TxnId
 
 _FIELDS = ("save_status", "durability", "route", "partial_txn", "partial_deps",
            "promised", "accepted_or_committed", "execute_at", "writes", "result",
-           "applied_locally")
+           "applied_locally", "elided_unapplied")
+# NOTE: elided_unapplied rides the identity-diff (`is`) skip like every other
+# field, so it is ASSIGN-ONLY on Command — mutating the set in place would
+# silently skip re-encoding (local/commands.py _note_elided_unless_applied
+# and the serve-time prune both reassign fresh sets)
 _MISSING = object()
 
 

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from ..topology.topology import Topology
 from ..utils.random import RandomSource
 from .cluster import Cluster
 
@@ -170,6 +171,108 @@ class RestartNemesis:
             self._task.cancel()
         for node_id in sorted(self.cluster.down):
             self._restart(node_id)
+
+
+class MembershipNemesis:
+    """Elastic membership under load: seeded join (``Cluster.add_node`` + a
+    join epoch through the randomizer's elastic mutations) and decommission
+    (``Cluster.decommission`` — hand-off + removal from every shard in one
+    epoch) cycles, holding the member count inside
+    [``min_members``, ``max_members``].
+
+    Floors shared with every other nemesis: joins/leaves respect the
+    randomizer's clean-readable-quorum-per-range check (a newcomer counts
+    unavailable until its bootstrap fetch lands), leaves additionally
+    require every affected shard to keep a live slow-path quorum counting
+    MUTED nodes (down / paused / journal-stalled) unavailable, and the whole
+    schedule is gated on outstanding bootstraps like topology churn — a
+    membership change is a bootstrap storm by construction, and stacking
+    them outruns the heal rate into expected (reported-as-stall)
+    unavailability."""
+
+    def __init__(self, cluster: Cluster, rng: RandomSource,
+                 randomizer, interval_s: float = 25.0,
+                 min_members: Optional[int] = None,
+                 max_members: Optional[int] = None,
+                 spawn_pool: Optional[list] = None,
+                 on_join: Optional[Callable[[int], None]] = None,
+                 on_leave: Optional[Callable[[int], None]] = None):
+        self.cluster = cluster
+        self.rng = rng
+        self.randomizer = randomizer
+        self.interval_s = interval_s
+        initial = len(cluster.topologies[-1].nodes())
+        self.min_members = min_members if min_members is not None \
+            else max(3, initial - 1)
+        self.max_members = max_members if max_members is not None \
+            else initial + max(2, initial // 2)
+        if spawn_pool:
+            self.randomizer.spawn_pool = sorted(
+                set(self.randomizer.spawn_pool) | set(spawn_pool))
+        # both membership planes honor the same bounds: the churn-mix
+        # join/leave actions otherwise bypass membership_{min,max}_members
+        self.randomizer.min_members = self.min_members
+        self.randomizer.max_members = self.max_members
+        self.on_join = on_join
+        self.on_leave = on_leave
+        self.joins = 0
+        self.leaves = 0
+        self.stopped = False
+        self._task = None
+
+    def attach(self) -> None:
+        rng = self.rng
+
+        def gap():
+            return self.interval_s * (0.5 + rng.next_float())
+
+        self._task = self.cluster.scheduler.recurring(gap, self._tick)
+
+    def _tick(self) -> None:
+        cluster = self.cluster
+        if self.stopped:
+            return
+        # same bootstrap gate as topology churn: a membership change while
+        # many ranges are mid-bootstrap stacks fetch load the cluster is
+        # already struggling to drain
+        pending = {rng for node in cluster.nodes.values()
+                   for cs in node.command_stores.all_stores()
+                   for rng in (cs.pending_bootstrap or ())}
+        if len(pending) > 3:
+            return
+        current = cluster.topologies[-1]
+        members = sorted(current.nodes())
+        want_join = len(members) <= self.min_members or (
+            len(members) < self.max_members and self.rng.next_boolean())
+        shards = list(current.shards)
+        if want_join:
+            new_shards = self.randomizer._join(shards, current)
+            if new_shards is None:
+                return
+            topo = Topology(current.epoch + 1, new_shards)
+            cluster.update_topology(topo)
+            self.joins += 1
+            joined = sorted(topo.nodes() - current.nodes())
+            if self.on_join is not None and joined:
+                self.on_join(joined[0])
+        else:
+            new_shards = self.randomizer._leave(shards, current)
+            if new_shards is None:
+                return
+            topo = Topology(current.epoch + 1, new_shards)
+            cluster.update_topology(topo)
+            self.leaves += 1
+            left = sorted(current.nodes() - topo.nodes())
+            if self.on_leave is not None and left:
+                self.on_leave(left[0])
+
+    def stop(self) -> None:
+        """Stop scheduling membership changes (burn quiesce).  Drained nodes
+        stay live — the final agreement checks judge the LAST topology's
+        replica sets, and prior epochs still need their members."""
+        self.stopped = True
+        if self._task is not None:
+            self._task.cancel()
 
 
 class PauseNemesis:

@@ -22,6 +22,27 @@ if TYPE_CHECKING:
     from .node import Node
 
 
+def refence_backoff(node: "Node", store: "CommandStore", delay: float) -> float:
+    """Stretch a re-fencing delay by the store's unapplied pressure (txns
+    decided ``refence_pressure_age_s`` ago with no local apply — the
+    condition the auditor's ``slo.unapplied`` plane flags), capped at
+    ``refence_backoff_max_s``.  Shared by the bootstrap retry ladder and the
+    staleness catch-up escalation: both allocate fresh fence sync points and
+    re-mark ``bootstrapped_at``, and firing them faster than in-flight reads
+    assemble partial coverage is the seed-6 bootstrap-refencing wedge."""
+    cfg = getattr(node, "config", None)
+    age_s = cfg.refence_pressure_age_s if cfg is not None else 10.0
+    cap_s = cfg.refence_backoff_max_s if cfg is not None else 30.0
+    pressure = store.unapplied_pressure(age_s)
+    if pressure <= 0:
+        return delay
+    obs = getattr(node, "observer", None)
+    if obs is not None:
+        obs.registry.counter("bootstrap.refence_backoffs",
+                             node=node.id, store=store.id).inc()
+    return min(max(delay, 1.0) * (1.0 + pressure), max(cap_s, delay))
+
+
 class Bootstrap:
     """One bootstrap attempt for one store's added ranges at one epoch."""
 
@@ -50,7 +71,14 @@ class Bootstrap:
         # retrying through a whole hostile run) gets past 1024 attempts —
         # values are identical below the cap (2**5 already saturates the 8s
         # ceiling)
-        return min(0.5 * (2.0 ** min(self.attempts - 1, 8)), 8.0)
+        delay = min(0.5 * (2.0 ** min(self.attempts - 1, 8)), 8.0)
+        # re-fencing cooperates with in-flight reads (the seed-6 wedge):
+        # every retry rung allocates a FRESH fence ESP and re-marks
+        # bootstrapped_at at the higher id.  While the store carries
+        # unapplied pressure (txns decided long ago, not applied — the
+        # slo.unapplied condition), the ladder is outrunning partial-read
+        # coverage assembly: stretch the rung so the reads win the race.
+        return refence_backoff(self.node, self.store, delay)
 
     def start(self) -> au.AsyncResult:
         self.store.pending_bootstrap = self.store.pending_bootstrap.union(self.ranges)
@@ -204,6 +232,11 @@ def _reevaluate_waiting(safe_store, ranges=None) -> None:
             if hit is None:
                 hit = memo[mk] = redundant.is_locally_redundant(dep_id, parts)
             if hit:
+                # elided below the advancing bootstrap bound: the write
+                # arrives with the fetch, not a local apply — noted so the
+                # read-serve path treats its slices as at-risk until it
+                # proves the dep landed (grandfathered serve)
+                C._note_elided_unless_applied(safe_store, command, dep_id)
                 waiting.remove(dep_id, True)
                 store.resolver.remove_waiting(command.txn_id, dep_id)
                 dep = safe_store.get_if_exists(dep_id)

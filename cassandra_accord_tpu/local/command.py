@@ -66,7 +66,7 @@ class Command:
         "route", "partial_txn", "partial_deps",
         "promised", "accepted_or_committed",
         "execute_at", "execute_at_least", "writes", "result",
-        "waiting_on", "listeners", "applied_locally",
+        "waiting_on", "listeners", "applied_locally", "elided_unapplied",
     )
 
     def __init__(self, txn_id: TxnId):
@@ -99,6 +99,22 @@ class Command:
         # a cache-miss fault-in must restore it, else evicted TRUNCATED_APPLY
         # copies refuse reads they can serve and recovery livelocks return.
         self.applied_locally: bool = False
+        # WRITE dependency ids dropped from this command's WaitingOn WITHOUT
+        # a local-apply proof at removal time — elided below a bootstrap
+        # fence (the fetch snapshot covers them, but only once it lands) or
+        # truncated without applying here.  Empty/None means the frontier
+        # drained entirely through local applies, so the local MVCC snapshot
+        # at executeAt is COMPLETE on the footprint — the grandfathered-serve
+        # condition that lets reads ignore pending-bootstrap/stale marks a
+        # LATER re-fence added (the seed-6 bootstrapping-refencing wedge).
+        # Entries are re-checked (and pruned) at serve time: an elided dep
+        # that has SINCE applied locally (or was delivered by a completed
+        # bootstrap fetch) stops being a risk.  JOURNALED (harness/journal
+        # _FIELDS) so crash-restart restores it for terminal commands, and
+        # snapshotted into CommandSummary so cache-miss fault-ins restore
+        # it — either gap launders a tainted floor dep clean.  ASSIGN-ONLY:
+        # the journal's identity-diff skip keys on object identity.
+        self.elided_unapplied: Optional[Set[TxnId]] = None
 
     # -- status queries -----------------------------------------------------
     @property

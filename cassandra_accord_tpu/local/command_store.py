@@ -75,7 +75,8 @@ class CommandSummary:
     a journal decode.  Terminal commands never change while cold, so the
     snapshot taken at evict time stays exact until fault-in discards it."""
     __slots__ = ("txn_id", "status", "save_status", "execute_at",
-                 "partial_deps", "footprint")
+                 "partial_deps", "footprint", "applied_locally",
+                 "elided_unapplied", "written_keys", "full_footprint")
 
     def __init__(self, cmd) -> None:
         self.txn_id = cmd.txn_id
@@ -85,6 +86,23 @@ class CommandSummary:
         self.partial_deps = None if cmd.partial_deps is None \
             else _SummaryDeps(frozenset(cmd.partial_deps.txn_ids()))
         self.footprint = command_footprint(cmd)
+        # the grandfathered-serve plane reads these off evicted commands:
+        # whether the dependency-ordered apply ran here, which write deps
+        # were dropped without a local-apply proof (still unresolved at
+        # evict time — terminal commands never resolve them later), and the
+        # routing keys the local writes slice actually covered (evaluated
+        # against all_ranges at QUERY time — ownership can grow after the
+        # evict, so a cached verdict would over-claim)
+        self.applied_locally = cmd.applied_locally
+        self.elided_unapplied = frozenset(cmd.elided_unapplied) \
+            if cmd.elided_unapplied else None
+        self.written_keys = None if cmd.writes is None else frozenset(
+            k.to_routing() if hasattr(k, "to_routing") else k
+            for k in cmd.writes.keys)
+        # full footprint for the writes-cover check (route travels whole;
+        # the partial_txn is sliced and would certify slices never held)
+        from ..local.commands import _dep_full_footprint
+        self.full_footprint = _dep_full_footprint(cmd)
 
 
 class CommandStore:
@@ -194,10 +212,20 @@ class CommandStore:
         loads; reloads here are synchronous, with the interleaving dimension
         exercised by DelayedAgentExecutor's deferred store tasks)."""
         self.cold.discard(txn_id)
-        self.cold_summaries.pop(txn_id, None)
+        summary = self.cold_summaries.pop(txn_id, None)
         cmd = self.journal.reconstruct_one(self, txn_id) \
             if self.journal is not None else None
         if cmd is not None:
+            if summary is not None and summary.elided_unapplied:
+                # restore the unresolved-elision set from the evict-time
+                # summary: it is journaled too, but the summary is FRESHER
+                # (serve-time prunes since the last journal save) — and
+                # without either restore the fault-in LAUNDERS the command
+                # into a falsely-clean floor dep and the grandfathered
+                # serve certifies slices whose writes only the still-
+                # outstanding bootstrap fetch can deliver (seed-6 k428
+                # prefix loss rode exactly this wash cycle)
+                cmd.elided_unapplied = set(summary.elided_unapplied)
             self.commands[txn_id] = cmd
             self.cache_miss_loads += 1
         return cmd
@@ -207,6 +235,35 @@ class CommandStore:
         for r in self.ranges_by_epoch.values():
             out = out.union(r)
         return out
+
+    def unapplied_pressure(self, min_age_s: float = 10.0,
+                           cap: int = 64) -> int:
+        """Count of txns DECIDED (stable-or-later) at least ``min_age_s`` of
+        sim-time ago that have not applied locally — the protocol-local
+        signal behind the auditor's ``slo.unapplied`` flag plane, computed
+        from store state only (never from the observer: zero observer
+        effect).  The bootstrap retry ladder and the staleness catch-up
+        escalation consult it to back off the re-fencing cadence while the
+        execution plane is visibly behind — re-fencing faster than in-flight
+        reads can assemble partial coverage is the seed-6 wedge."""
+        from .status import SaveStatus as _SS, Status as _S
+        horizon = self.node.now_micros() - int(min_age_s * 1_000_000)
+        n = 0
+        for cmd in self.commands.values():
+            ss = cmd.save_status
+            if not ss.has_been(_S.STABLE) or ss.is_truncated \
+                    or ss is _SS.INVALIDATED \
+                    or ss.ordinal >= _SS.APPLIED.ordinal:
+                continue
+            ts = cmd.execute_at if cmd.execute_at is not None else cmd.txn_id
+            if ts.hlc <= horizon:
+                n += 1
+                if n >= cap:
+                    # the consumers scale a delay that saturates long before
+                    # this; don't finish an O(commands) scan per retry rung
+                    # just to refine a number past its use
+                    return n
+        return n
 
     # -- execution ----------------------------------------------------------
     def execute(self, task: Callable[["SafeCommandStore"], None]) -> None:
@@ -618,6 +675,14 @@ class SafeCommandStore:
                     store.transient_listeners.pop(txn_id, None)
                     if store.journal is not None:
                         store.journal.erase(store, txn_id)
+                    continue
+                if cmd.save_status is SaveStatus.INVALIDATED:
+                    # NOT yet shard-redundant: the tombstone must persist AS
+                    # INVALIDATED until the shard fence (downgrading it to
+                    # ERASED weakens "decided invalid" to "unknowable" and
+                    # re-opens the round-4 resurrection class; the auditor's
+                    # edge table forbids INVALIDATED -> ERASED for the same
+                    # reason)
                     continue
             C.truncate(self, cmd, cleanup)
         # prune conflict indexes below the shard-applied bound per key, and

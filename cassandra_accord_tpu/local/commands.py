@@ -140,6 +140,13 @@ def recover(safe_store: SafeCommandStore, txn_id: TxnId, partial_txn: PartialTxn
 
 def accept(safe_store: SafeCommandStore, txn_id: TxnId, ballot: Ballot, route: Route,
            execute_at: Timestamp, partial_deps: Deps) -> AcceptOutcome:
+    if _is_shard_redundant(safe_store, txn_id, route):
+        # GC physically erased this txn (applied at every replica, below the
+        # shard fence): a LATE Accept — chaos latencies reach seconds — must
+        # not re-create it fresh at ballot zero (the auditor catches the
+        # resurrection as a promised-ballot regression; stale re-created
+        # ACCEPTED evidence is the round-3/4 unsound-recovery shape)
+        return AcceptOutcome.TRUNCATED
     command = safe_store.get_or_create(txn_id)
     if command.save_status.is_truncated:
         return AcceptOutcome.TRUNCATED
@@ -414,16 +421,30 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
         # removeRedundantDependencies (Commands.java:704-705): deps below the
         # locally-redundant bound have applied (or are subsumed by bootstrap)
         if min_fence is not None and dep_id < min_fence:
+            # the fence may be a bootstrap mark whose fetch has not landed:
+            # without a local-apply proof the dep's write is not provably in
+            # the local snapshot — note it (the read-serve path re-checks)
+            _note_elided_unless_applied(safe_store, command, dep_id)
             continue
         dep_parts = deps.participants(dep_id)
         if dep_parts is not None and redundant.is_locally_redundant(dep_id, dep_parts):
+            _note_elided_unless_applied(safe_store, command, dep_id)
             continue
-        if dep_parts is not None and not _participates_at_epoch(safe_store, dep_id,
-                                                               dep_parts):
-            # this store does not own the dep's footprint at the dep's epoch:
-            # the dep will never be applied HERE (its Apply targets that
-            # epoch's replicas) — waiting would deadlock topology-spanning
-            # commands (StoreParticipants execution gating)
+        if dep_parts is not None and not _participates_at_epoch(
+                safe_store, dep_id, dep_parts,
+                max_epoch=execute_at.epoch if execute_at is not None else None):
+            # this store owns none of the dep's footprint at ANY epoch the
+            # dep can execute in — [dep.txnId.epoch, OUR executeAt.epoch]:
+            # the dep executes before us, so its executeAt epoch is bounded
+            # by ours — so its Apply will never be addressed HERE and
+            # waiting would deadlock topology-spanning commands
+            # (StoreParticipants execution gating).  Judging by the TXN
+            # epoch alone dropped epoch-spanning slow-path deps at stores
+            # that joined the range by the EXECUTION epoch — their applies
+            # DO arrive here, and executing without them served reads
+            # missing their writes (the 15-node elastic cycle: op 186's
+            # read of k58 missed op 181, txn epoch 5 executed at epoch 9).
+            _note_elided_unless_applied(safe_store, command, dep_id)
             continue
         if _still_blocks(safe_store, command, dep_id, execute_at):
             waiting.add(dep_id)
@@ -431,6 +452,15 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
             dep.listeners.add(command.txn_id)
             deferred |= _maybe_defer_execute_at_least(safe_store, command, dep,
                                                      notify=False)
+        else:
+            dep = safe_store.store.commands.get(dep_id)
+            executes_after = dep is not None \
+                and dep.has_been(Status.PRE_COMMITTED) \
+                and dep.effective_execute_at() is not None \
+                and execute_at is not None \
+                and dep.effective_execute_at() >= execute_at
+            if not executes_after:
+                _note_elided_unless_applied(safe_store, command, dep_id)
     command.waiting_on = WaitingOn(waiting)
     # mirror the wait edges into the resolver's execution-frontier plane
     safe_store.store.resolver.register_waiting(command.txn_id, waiting)
@@ -439,9 +469,19 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
 
 
 def _participates_at_epoch(safe_store: SafeCommandStore, dep_id: TxnId,
-                           dep_parts) -> bool:
-    """Does this store own any of the dep's footprint at the dep's epoch?"""
-    owned = safe_store.store.ranges_at(dep_id.epoch)
+                           dep_parts, max_epoch: Optional[int] = None) -> bool:
+    """Does this store own any of the dep's footprint at any epoch the dep
+    can apply in — [dep.txnId.epoch, max_epoch]?  ``max_epoch`` is the
+    WAITER's execution epoch (the dep executes before the waiter, so its
+    executeAt epoch is bounded by it); None restricts to the dep's own
+    epoch.  Applies are addressed to the replicas of every epoch in
+    [txnId.epoch, executeAt.epoch], so ownership anywhere in the window
+    means the apply may land here and is worth waiting for."""
+    store = safe_store.store
+    hi = dep_id.epoch if max_epoch is None else max(dep_id.epoch, max_epoch)
+    owned = Ranges.EMPTY
+    for e in range(dep_id.epoch, hi + 1):
+        owned = owned.union(store.ranges_at(e))
     if not owned:
         return False
     keys, rngs = dep_parts
@@ -478,6 +518,21 @@ def _maybe_defer_execute_at_least(safe_store: SafeCommandStore, waiter: Command,
 
 def _still_blocks(safe_store: SafeCommandStore, command: Command, dep_id: TxnId,
                   execute_at: Timestamp) -> bool:
+    from ..primitives.timestamp import TxnKind as _TK
+    if dep_id.kind is _TK.READ:
+        # MVCC execution rule: a read-only dependency WRITES nothing, so its
+        # local apply contributes nothing to any later txn's snapshot — and
+        # the read itself stays servable at its own executeAt from the
+        # timestamped store no matter what applies above it (the same
+        # property the applied-copy exclusive-snapshot serve relies on).
+        # The reference (ReadData over a non-versioned store) must order
+        # writes after reads; here that edge is pure liveness surface: under
+        # churn it is THE seed-6 wedge — a client range read that cannot
+        # assemble partial coverage blocks every later write AND the
+        # bootstrap fence sync points, whose pending ranges are exactly why
+        # the read lacks coverage.  Reads still wait on THEIR deps (writes
+        # below their snapshot); nothing waits on reads.
+        return False
     if dep_id in safe_store.store.cold:
         # eviction admits only terminal commands (applied/invalidated/
         # truncated), none of which block — answering from the cold set
@@ -504,6 +559,127 @@ def _still_blocks(safe_store: SafeCommandStore, command: Command, dep_id: TxnId,
     return True
 
 
+def _writes_cover_owned_footprint(store, footprint, written_keys) -> bool:
+    """Does a locally-APPLIED dep's writes slice (``written_keys``: routing
+    keys, or None when the payload is stripped) cover every part of its
+    footprint this store owns (in ANY epoch)?  "Applied" is per-SLICE: a
+    store that held only part of the dep's payload (it owned only part of
+    the footprint at the dep's epochs) applied only that part — a slice it
+    adopted LATER never got its write (that arrives with the bootstrap
+    fetch), and the partial apply must not certify it (seed-6 trajectory:
+    node 1's other-key-only APPLY of op 12 certified the k285 write it
+    never held, and a read served over the pending fetch missed v12.1)."""
+    if footprint is None:
+        return False
+    from ..primitives.keys import Ranges as _Ranges
+    owned = store.all_ranges()
+    if isinstance(footprint, _Ranges):
+        # range-domain writes carry no per-key payload to compare; only an
+        # empty owned overlap is trivially covered
+        return not owned.intersects(footprint)
+    for key in footprint:
+        rk = key.to_routing() if hasattr(key, "to_routing") else key
+        if owned.contains(rk) and (written_keys is None
+                                   or rk not in written_keys):
+            return False
+    return True
+
+
+def _written_routing_keys(writes):
+    if writes is None:
+        return None
+    return {k.to_routing() if hasattr(k, "to_routing") else k
+            for k in writes.keys}
+
+
+def _dep_full_footprint(cmd):
+    """The dep's FULL footprint for the writes-cover check: the route (which
+    travels whole) — the partial_txn is SLICED to what this store received,
+    so judging coverage by it would certify exactly the slices the store
+    never held (the hole the check exists to close)."""
+    if cmd.route is not None:
+        return cmd.route.participants()
+    return cmd.partial_txn.keys if cmd.partial_txn is not None else None
+
+
+def _dep_applied_locally(store, dep_id: TxnId) -> bool:
+    """Is ``dep_id``'s write provably in THIS store's data (or provably
+    nonexistent)?  APPLIED / applied_locally means the dependency-ordered
+    apply ran here — for the SLICE the store held (checked against the
+    writes payload, see _writes_cover_owned_footprint); INVALIDATED writes
+    nothing.  Cold deps answer from their terminal summaries (which carry
+    applied_locally and the writes cover) without a fault-in.  A dep that
+    applied while still carrying unresolved elisions of its own does NOT
+    count: its write landed but the fence floor-dep it stood in for may
+    cover predecessors that did not (transitive contamination)."""
+    from .status import SaveStatus as _SS
+    if dep_id in store.cold:
+        summary = store.cold_summaries.get(dep_id)
+        if summary is None:
+            return False
+        if summary.save_status is _SS.INVALIDATED:
+            # an INVALIDATED write/read never happened — clean; an
+            # INVALIDATED sync point is an ABANDONED fence whose barrier
+            # claim never materialized, yet it may have been handed out as
+            # the floor dep standing in for writes elided below its
+            # (pre-marked) bootstrapped_at bound — those writes are
+            # unaccounted, so the floor stays unresolved (seed-6 v9..v85
+            # prefix loss rode an abandoned-fence floor removal)
+            return not dep_id.kind.is_sync_point
+        applied = summary.save_status is _SS.APPLIED or summary.applied_locally
+        if not applied:
+            return False
+        if dep_id.is_write:
+            # a WRITE dep resolves on its own write's local presence; its
+            # OWN elided predecessors are NOT inherited — any of them that
+            # conflict with the waiter below the waiter's executeAt are in
+            # the WAITER's deps (directly or via floors) and accounted for
+            # separately.  Inheriting them built never-resolving taint
+            # chains through applied writes (the seed-8 liveness wedge).
+            return _writes_cover_owned_footprint(store, summary.full_footprint,
+                                                 summary.written_keys)
+        # sync points write nothing: applied with no unresolved elisions IS
+        # the (per-store) barrier claim — everything below it on its local
+        # slice applied here.  A floor with unresolved elisions stays
+        # unresolved: it STANDS IN for exactly those writes.
+        return not summary.elided_unapplied
+    dep = store.commands.get(dep_id)
+    if dep is None:
+        return False
+    if dep.save_status is _SS.INVALIDATED:
+        # abandoned fences are NOT clean: see the cold-summary branch above
+        return not dep_id.kind.is_sync_point
+    applied = dep.save_status is _SS.APPLIED or dep.applied_locally
+    if not applied:
+        return False
+    if dep_id.is_write:
+        return _writes_cover_owned_footprint(store, _dep_full_footprint(dep),
+                                             _written_routing_keys(dep.writes))
+    return not dep.elided_unapplied
+
+
+def _note_elided_unless_applied(safe_store: SafeCommandStore, command: Command,
+                                dep_id: TxnId) -> None:
+    """Record a WaitingOn drop that lacks a local-apply proof.  Read deps
+    never matter (they contribute nothing to any snapshot); WRITES do, and
+    so do SYNC POINTS — a fence floor dep STANDS IN for the write deps the
+    deps calculation elided below it, so dropping a fence whose own
+    elisions are unresolved inherits the risk (transitive: the seed-6
+    v12.1 loss rode exactly this — the bootstrap fence replaced the write
+    in the waiter's deps and was itself applied mid-fetch)."""
+    from ..primitives.timestamp import TxnKind as _TK
+    if dep_id.kind is _TK.READ:
+        return
+    if _dep_applied_locally(safe_store.store, dep_id):
+        return
+    # ASSIGN-ONLY (never mutate in place): the journal's identity-diff skip
+    # keys on object identity, and an in-place add would silently journal a
+    # stale set (harness/journal.py _FIELDS note)
+    prev = command.elided_unapplied or frozenset()
+    if dep_id not in prev:
+        command.elided_unapplied = set(prev) | {dep_id}
+
+
 def update_dependency_and_maybe_execute(safe_store: SafeCommandStore, waiter: Command,
                                         dep: Command) -> None:
     """Called when ``dep`` changes status and ``waiter`` is listening
@@ -513,6 +689,14 @@ def update_dependency_and_maybe_execute(safe_store: SafeCommandStore, waiter: Co
     _maybe_defer_execute_at_least(safe_store, waiter, dep)
     if not _still_blocks(safe_store, waiter, dep.txn_id, waiter.execute_at):
         applied = dep.save_status is SaveStatus.APPLIED or dep.save_status.is_truncated
+        dep_ea = dep.effective_execute_at()
+        executes_after = dep.has_been(Status.PRE_COMMITTED) \
+            and dep_ea is not None and waiter.execute_at is not None \
+            and dep_ea >= waiter.execute_at \
+            and dep.save_status is not SaveStatus.APPLIED \
+            and not dep.save_status.is_truncated
+        if not executes_after:
+            _note_elided_unless_applied(safe_store, waiter, dep.txn_id)
         waiter.waiting_on.remove(dep.txn_id, applied)
         safe_store.store.resolver.remove_waiting(waiter.txn_id, dep.txn_id)
         dep.listeners.discard(waiter.txn_id)

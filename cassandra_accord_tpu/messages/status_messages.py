@@ -72,6 +72,15 @@ class CheckStatusOk(Reply):
 
     @staticmethod
     def of(txn_id: TxnId, command, local_ranges=None) -> "CheckStatusOk":
+        """``local_ranges`` must be the ranges this store's PAYLOAD slices
+        actually cover — the ranges it owned at the txn's coordination epochs
+        (``payload_coverage``), NOT its current ranges.  A store that adopted
+        a range AFTER the txn's epochs holds deps/writes slices that never
+        included it; claiming current ranges let a peer adopt a partial
+        writes payload as if it covered the newly-adopted range and silently
+        drop the missing key's write (seed-6 elastic trajectory: node 5's
+        epoch-8 [k857]-only slice adopted at node 3 as covering k285 —
+        replica divergence, v80.0 lost)."""
         from ..primitives.keys import Ranges
         local = local_ranges if local_ranges is not None else Ranges.EMPTY
         invalidated = command.save_status is SaveStatus.INVALIDATED
@@ -89,6 +98,23 @@ class CheckStatusOk(Reply):
                              command.durability, command.route, command.partial_txn,
                              command.partial_deps, command.writes, command.result,
                              stable_for=stable_for, applied_for=applied_for)
+
+    @staticmethod
+    def payload_coverage(safe_store, txn_id: TxnId, command):
+        """The ranges this store's txn/deps/writes slices can actually cover:
+        the union of the ranges it owned over the txn's coordination window
+        [txnId.epoch, executeAt.epoch] — what ``compute_scope`` sliced the
+        payloads to when they were sent here.  Ranges adopted in LATER epochs
+        are excluded: no payload for them ever arrived."""
+        from ..primitives.keys import Ranges
+        lo = txn_id.epoch
+        hi = lo
+        if command is not None and command.execute_at is not None:
+            hi = max(hi, command.execute_at.epoch)
+        covered = Ranges.EMPTY
+        for e in range(lo, hi + 1):
+            covered = covered.union(safe_store.ranges_at(e))
+        return covered
 
     @staticmethod
     def infer_invalid_hint(safe_store, txn_id: TxnId, command) -> bool:
@@ -200,7 +226,9 @@ class CheckStatus(TxnRequest):
                 ok = CheckStatusOk.empty(txn_id)
                 ok.invalid_if_undecided = hint
                 return ok
-            ok = CheckStatusOk.of(txn_id, command, safe_store.current_ranges())
+            ok = CheckStatusOk.of(
+                txn_id, command,
+                CheckStatusOk.payload_coverage(safe_store, txn_id, command))
             ok.invalid_if_undecided = hint
             if not include_info:
                 from ..primitives.keys import Ranges
@@ -244,9 +272,30 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk):
     route = merged.route
     if route is None:
         return au.success_result(None)
+    # span through the node's CURRENT epoch, not just the execution epoch: a
+    # store that adopted the footprint AFTER the txn's era (elastic joins,
+    # churn re-adoptions) holds waiters on this txn but owns nothing at the
+    # txn's own epochs — propagation targeted at [txn, exec] never visits
+    # it and its waiters starve on knowledge every peer already has (the
+    # seed-6 restart-matrix k428 hole).  Per-store slicing gates below keep
+    # the application sound.
     max_epoch = merged.execute_at.epoch if merged.execute_at is not None else txn_id.epoch
+    max_epoch = max(max_epoch, node.topology.current_epoch)
 
     def for_store(safe_store: SafeCommandStore) -> None:
+        existing = safe_store.get_if_exists(txn_id)
+        if (existing is None or not existing.listeners) \
+                and C._is_shard_redundant(safe_store, txn_id, route):
+            # GC physically erased this txn below the shard fence: late
+            # knowledge propagation — including truncated-outcome adoption
+            # onto a freshly-created stub — must not resurrect it (ballot
+            # regression; the round-4 resurrection class).  EXCEPT when a
+            # local waiter still lists it as a dependency (listeners): then
+            # propagation is the HEAL that unblocks the waiter and lands
+            # the write this lagging replica never applied — fending that
+            # off wedged whole PRE_APPLIED chains behind one unwitnessed
+            # dep (the seed-6 restart-matrix k428 hole).
+            return
         status = merged.save_status
         if status is SaveStatus.INVALIDATED:
             C.commit_invalidate(safe_store, txn_id, scope=route)
@@ -295,6 +344,23 @@ def propagate_knowledge(node: "Node", txn_id: TxnId, merged: CheckStatusOk):
                     # predecessor; the hostile 1000-op burn caught replicas
                     # diverging with holes exactly here)
                     _heal_store_gaps(node, safe_store, local_parts_t)
+            elif txn_id.is_write and len(local_parts_t):
+                # truncated upstream with the WRITES STRIPPED (plain
+                # TRUNCATE tier — executeAt still known): this replica can
+                # neither adopt the outcome nor ever receive the individual
+                # Apply — the same one-replica hole round 7 closed for the
+                # executeAt-unknown case, found again on the seed-6 restart
+                # trajectory (node 1's k428 epoch-9 cohort).  Heal the gap
+                # from peer snapshots and collapse the local copy to an
+                # ERASED tombstone so waiters stop waiting on an apply that
+                # cannot happen (reads stay refused by the stale mark until
+                # the heal lands).
+                from ..local.durability import Cleanup
+                if not command.has_been(Status.PRE_COMMITTED):
+                    # pre-committed copies heal inside C.truncate's own
+                    # data-gap guard; bare stubs need it launched here
+                    _heal_store_gaps(node, safe_store, local_parts_t)
+                C.truncate(safe_store, command, Cleanup.ERASE)
             return
         # gate each tier on the merged knowledge actually covering THIS store's
         # slice of the route (the reference's Known.sufficientFor per-store gate,
@@ -384,14 +450,29 @@ def _heal_store_gaps(node: "Node", safe_store: SafeCommandStore,
         stream the data (complete up to that NEW fence, so writes committed
         DURING the outage are covered too), and advance bootstrapped_at.  The
         ladder retries with its own backoff until peers return; the stale
-        mark clears only on completion."""
-        from ..local.bootstrap import Bootstrap
+        mark clears only on completion.
+
+        The LAUNCH itself is paced by the store's unapplied pressure
+        (refence_backoff): a catch-up bootstrap re-fences the footprint with
+        a fresh exclusive sync point, and firing it while decided txns sit
+        unapplied (the seed-6 slo.unapplied condition) re-fences faster
+        than the wedged reads can assemble coverage — the exact cadence the
+        truncation/staleness ladder must back off."""
+        from ..local.bootstrap import Bootstrap, refence_backoff
 
         def on_done(_v, failure) -> None:
             if failure is None:
                 store.clear_stale(token)
-        Bootstrap(node, command_store, state["open"], node.epoch(),
-                  catch_up=True).start().add_listener(on_done)
+
+        def launch() -> None:
+            Bootstrap(node, command_store, state["open"], node.epoch(),
+                      catch_up=True).start().add_listener(on_done)
+
+        delay = refence_backoff(node, command_store, 0.0)
+        if delay > 0.0:
+            node.scheduler.once(delay, launch)
+        else:
+            launch()
 
     def attempt(delay: float) -> None:
         """One heal round over the still-open footprint; unhealed remainder

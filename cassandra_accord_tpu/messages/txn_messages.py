@@ -18,7 +18,7 @@ from ..local.cfk import InternalStatus
 from ..local.command_store import SafeCommandStore
 from ..local.status import SaveStatus, Status
 from ..primitives.deps import Deps, DepsBuilder
-from ..primitives.keys import Keys, Ranges
+from ..primitives.keys import Keys, Range, Ranges
 from ..primitives.route import Route
 from ..primitives.timestamp import Ballot, Timestamp, TxnId
 from ..primitives.txn import PartialTxn, Writes
@@ -566,6 +566,124 @@ class _ExclusiveSnapshotView:
         return self._ds.get_at(key, execute_at, exclusive=True)
 
 
+def _unresolved_elision_cover(s: SafeCommandStore, command):
+    """(hard, soft, whole): the slices still at risk for ``command``'s read —
+    the footprints of its elided-without-local-apply write deps that have
+    STILL not landed here.  Resolved entries are pruned (monotone — a dep
+    never un-applies, and a completed fetch never un-delivers).
+
+    ``hard`` slices refuse REGARDLESS of pending-bootstrap/stale marks:
+    their dep is locally WITNESSED and still IN FLIGHT (below APPLIED, not
+    truncated) — its Apply is coming, and the elision bound that dropped it
+    was the lie (seed-8: a fence that never witnessed a minority-witnessed
+    in-flight write advanced locally_applied_before past it with NO pending
+    mark covering the gap; op 201's range read then served k750 while
+    op 150 was still mid-recovery — v150.0 missing).  The refusal
+    self-heals when the dep's Apply lands.
+
+    ``soft`` slices (dep absent / truncated-era / unknown here) refuse only
+    where a fetch is OUTSTANDING — the caller intersects with the pending
+    marks; their data story is the bootstrap fetch, and refusing them
+    unconditionally rebuilt the seed-6 wedge (ancient elided deps never
+    "resolve" in command state: their writes arrived by fetch).
+
+    ``whole`` is True when some unresolved dep's footprint cannot be
+    derived (no partial_deps participants): fully conservative fallback."""
+    from ..local.commands import _dep_applied_locally
+    from ..local.status import SaveStatus as _SS
+    from ..primitives.keys import _Successor
+    elided = command.elided_unapplied
+    if not elided:
+        return Ranges.EMPTY, Ranges.EMPTY, False
+    store = s.store
+    deps = command.partial_deps
+    hard: list = []
+    soft: list = []
+    whole = False
+    unresolved = set()
+    for dep_id in elided:
+        parts = deps.participants(dep_id) if deps is not None else None
+        if _dep_applied_locally(store, dep_id) \
+                or _fetch_covered(s, dep_id, parts):
+            continue   # landed/fetched since: pruned below (assign-only)
+        unresolved.add(dep_id)
+        if parts is None:
+            whole = True
+            continue
+        dep = store.commands.get(dep_id)
+        in_flight = dep is not None \
+            and not dep.save_status.is_truncated \
+            and dep.save_status is not _SS.INVALIDATED \
+            and dep.save_status.ordinal < _SS.APPLIED.ordinal
+        out = hard if in_flight else soft
+        keys, rngs = parts
+        out.extend(Range(rk, _Successor(rk)) for rk in keys)
+        out.extend(rngs)
+    if len(unresolved) != len(elided):
+        # prune resolved entries with a FRESH set — the journal's identity-
+        # diff skip keys on object identity (harness/journal.py _FIELDS)
+        command.elided_unapplied = unresolved or None
+    return (Ranges.of(*hard) if hard else Ranges.EMPTY,
+            Ranges.of(*soft) if soft else Ranges.EMPTY,
+            whole)
+
+
+def _fetch_covered(s: SafeCommandStore, dep_id, parts) -> bool:
+    """Was an elided dep's write DELIVERED BY A COMPLETED BOOTSTRAP FETCH?
+    True when the dep provably executes below the ``bootstrapped_at`` fence
+    on every part of its footprint this store owns, and no fetch is
+    outstanding there (no pending-bootstrap/stale mark): the fetch snapshot
+    was complete up to the fence, so the write is in the data even though
+    the dep's Apply never ran here.  An unknown executeAt, a part above the
+    fence, or an outstanding fetch stays unresolved — the seed-8 lie was a
+    fence advancing past an in-flight write it never witnessed, whose
+    executeAt landed ABOVE the fence."""
+    store = s.store
+    cmd = store.commands.get(dep_id)
+    if cmd is None:
+        cmd = store.cold_summaries.get(dep_id)
+    exec_at = getattr(cmd, "execute_at", None) if cmd is not None else None
+    if exec_at is None:
+        return False
+    owned = store.all_ranges()
+    pending = store.pending_bootstrap or Ranges.EMPTY
+    stale = getattr(s.data_store(), "stale_ranges", None)
+    if stale is not None and len(stale):
+        pending = pending.union(stale)
+    rb = store.redundant_before
+    if parts is None:
+        return False
+    keys, rngs = parts
+    checked = False
+
+    def point_ok(rk) -> bool:
+        if pending.contains(rk):
+            return False
+        e = rb.entry(rk)
+        b = e.bootstrapped_at if e is not None else None
+        return b is not None and exec_at < b.as_timestamp()
+
+    for key in keys:
+        rk = key.to_routing() if hasattr(key, "to_routing") else key
+        if not owned.contains(rk):
+            continue
+        checked = True
+        if not point_ok(rk):
+            return False
+    for rng in rngs:
+        probe = Ranges.of(rng)
+        sliced = owned.intersection(probe)
+        for piece in sliced:
+            checked = True
+            if pending.intersects(Ranges.of(piece)):
+                return False
+            for e in rb.map.values_over(piece.start, piece.end):
+                b = e.bootstrapped_at if e is not None else None
+                if b is None or not exec_at < b.as_timestamp():
+                    return False
+    return checked
+
+
 def _serve_read(s: SafeCommandStore, command, result, fallback_txn,
                 applied: bool = False) -> bool:
     """Serve the executeAt snapshot from this store: read the CLEAN slice and
@@ -589,6 +707,30 @@ def _serve_read(s: SafeCommandStore, command, result, fallback_txn,
     stale = getattr(s.data_store(), "stale_ranges", None)
     if stale is not None and len(stale):
         pending = pending.union(stale) if pending else stale
+    if command.waiting_on is not None \
+            and (command.save_status in (SaveStatus.READY_TO_EXECUTE,
+                                         SaveStatus.APPLYING,
+                                         SaveStatus.APPLIED)
+                 or command.applied_locally):
+        # GRANDFATHERED SERVE (the seed-6 bootstrap-refencing wedge): where
+        # this command's WaitingOn drained through LOCAL applies, its stable
+        # deps — which cover every conflicting write below executeAt — all
+        # landed in this store's MVCC snapshot, so the snapshot at executeAt
+        # is COMPLETE there regardless of pending-bootstrap/stale marks a
+        # LATER re-fence added.  The unavailable set therefore becomes the
+        # slices touched by UNRESOLVED elisions only: hard (in-flight local
+        # dep — refuses regardless of pending; the seed-8 unwitnessed-write
+        # fence advance) union soft-within-pending (fetch-story deps gate on
+        # an outstanding fetch).  Pending WITHOUT elisions is forgiven —
+        # refusing the whole footprint is what raced coverage assembly
+        # against the re-fencing cadence until every replica of a slice was
+        # simultaneously fenced: the seed-6 circular wait.
+        hard, soft, whole = _unresolved_elision_cover(s, command)
+        if whole:
+            pending = pending.union(hard) if pending else hard
+        else:
+            pending = hard.union(pending.intersection(soft)) if pending \
+                else hard
     unavailable = Ranges.EMPTY
     if pending:
         k = ptxn.keys

@@ -1,0 +1,242 @@
+"""Elastic membership: join (``Cluster.add_node`` + join epoch) and
+decommission (``Cluster.decommission`` hand-off) under load.
+
+Covers ISSUE 8's membership-change contract: a joining node bootstraps its
+ranges from live peers (fence sync point + data fetch through the PR-1/2
+journal/bootstrap machinery) and serves reads only after the fetch lands; a
+leaving node hands off and is removed from every shard without data loss; a
+joiner crashing mid-bootstrap recovers through the restart catch-up ladder;
+and the elastic burn is deterministic with the flight recorder on vs off
+(zero observer effect extends to the membership plane)."""
+import pytest
+
+from dataclasses import replace
+
+from cassandra_accord_tpu.config import LocalConfig
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.cluster import Cluster
+from cassandra_accord_tpu.harness.nemesis import MembershipNemesis
+from cassandra_accord_tpu.harness.topology_randomizer import TopologyRandomizer
+from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+from cassandra_accord_tpu.impl.list_store import list_txn
+from cassandra_accord_tpu.primitives.keys import IntKey, Range
+from cassandra_accord_tpu.topology.topology import Shard, Topology
+from cassandra_accord_tpu.utils.random import RandomSource
+
+
+def k(v):
+    return IntKey(v)
+
+
+def make_cluster(nodes=(1, 2, 3), seed=5, **kw):
+    topo = Topology(1, [Shard(Range(k(0), k(1000)), list(nodes))])
+    return Cluster(topo, seed=seed, journal=True, progress_log=True,
+                   progress_poll_s=0.2, **kw)
+
+
+def write(cluster, node_id, appends):
+    return cluster.nodes[node_id].coordinate(
+        list_txn([], {k(key): v for key, v in appends.items()}))
+
+
+# ---------------------------------------------------------------------------
+# Cluster.add_node + join epoch
+# ---------------------------------------------------------------------------
+
+def test_join_bootstraps_and_serves_reads_only_after_fetch():
+    """A mid-run-spawned node joins a shard: its adopted range is
+    pending-bootstrap (reads refused there; peers/union serve) until the
+    fetch lands, after which it holds the pre-join data and serves reads."""
+    cluster = make_cluster(seed=7)
+    w = write(cluster, 1, {10: "pre", 700: "pre2"})
+    assert cluster.run_until(w.is_done)
+    cluster.run_until_idle()
+
+    node4 = cluster.add_node(4)
+    assert 4 in cluster.nodes and cluster.stats.get("node_joins") == 1
+    # not yet a member: owns nothing, no bootstrap launched
+    assert all(not cs.pending_bootstrap
+               for cs in node4.command_stores.all_stores())
+
+    cluster.update_topology(Topology(2, [
+        Shard(Range(k(0), k(1000)), [1, 2, 4])]))
+    # the join epoch's adoption diff marks the range pending at node 4
+    cluster.run_until(lambda: any(
+        cs.pending_bootstrap for cs in node4.command_stores.all_stores()),
+        max_tasks=200_000)
+    store4 = node4.command_stores.all_stores()[0]
+    assert store4.pending_bootstrap, "join must enter the bootstrap ladder"
+    # reads DURING the joiner's bootstrap still succeed (peers serve)
+    r = cluster.nodes[2].coordinate(list_txn([k(10)], {}))
+    assert cluster.run_until(r.is_done, max_tasks=2_000_000)
+    assert r.value.reads[k(10)] == ("pre",)
+    cluster.run_until_idle()
+    # bootstrap complete: fetched pre-join data, serves afterwards
+    assert not store4.pending_bootstrap
+    assert cluster.stores[4].get(k(10)) == ("pre",)
+    assert cluster.stores[4].get(k(700)) == ("pre2",)
+    e = store4.redundant_before.entry(k(10).to_routing())
+    assert e is not None and e.bootstrapped_at is not None
+    w2 = write(cluster, 4, {10: "post"})
+    assert cluster.run_until(w2.is_done)
+    cluster.run_until_idle()
+    assert cluster.stores[4].get(k(10)) == ("pre", "post")
+
+
+def test_join_while_loaded_no_write_loss():
+    """Writes in flight across the join epoch all survive into the
+    post-join replica set, consistently."""
+    cluster = make_cluster(seed=11)
+    results = [write(cluster, 1 + (i % 3), {5: f"a{i}"}) for i in range(4)]
+    cluster.add_node(4)
+    cluster.update_topology(Topology(2, [
+        Shard(Range(k(0), k(1000)), [1, 2, 4])]))
+    results += [write(cluster, 1 + (i % 3), {5: f"b{i}"}) for i in range(4)]
+    assert cluster.run_until(lambda: all(r.is_done() for r in results),
+                             max_tasks=5_000_000)
+    cluster.run_until_idle()
+    lists = {cluster.stores[n].get(k(5)) for n in (1, 2, 4)}
+    assert len(lists) == 1, lists
+    assert sorted(lists.pop()) == sorted(
+        [f"a{i}" for i in range(4)] + [f"b{i}" for i in range(4)])
+
+
+def test_join_crash_mid_bootstrap_recovers():
+    """A joiner crashing MID-BOOTSTRAP re-enters the catch-up ladder at
+    restart (the crash carries pending_bootstrap as restart debt) and still
+    converges with the pre-join data."""
+    cluster = make_cluster(seed=13)
+    w = write(cluster, 1, {10: "pre"})
+    assert cluster.run_until(w.is_done)
+    cluster.run_until_idle()
+    node4 = cluster.add_node(4)
+    cluster.update_topology(Topology(2, [
+        Shard(Range(k(0), k(1000)), [1, 2, 4])]))
+    cluster.run_until(lambda: any(
+        cs.pending_bootstrap for cs in node4.command_stores.all_stores()),
+        max_tasks=200_000)
+    assert any(cs.pending_bootstrap
+               for cs in node4.command_stores.all_stores())
+    cluster.crash(4)
+    cluster.run_for(2)
+    cluster.restart(4)
+    cluster.run_for(60)
+    assert cluster.stores[4].get(k(10)) == ("pre",)
+    store4 = cluster.nodes[4].command_stores.all_stores()[0]
+    assert not store4.pending_bootstrap
+
+
+# ---------------------------------------------------------------------------
+# Cluster.decommission
+# ---------------------------------------------------------------------------
+
+def test_decommission_hands_off_without_data_loss():
+    """The leaver is removed from every shard in one epoch; replacements
+    bootstrap its data; the drained process stays live serving old epochs."""
+    cluster = make_cluster(nodes=(1, 2, 3), seed=17, extra_nodes=[4])
+    w = write(cluster, 1, {10: "v1", 900: "v2"})
+    assert cluster.run_until(w.is_done)
+    cluster.run_until_idle()
+    topo = cluster.decommission(3)
+    assert topo is not None and 3 not in topo.nodes()
+    assert 3 in cluster.decommissioned and 3 in cluster.nodes
+    assert cluster.stats.get("node_decommissions") == 1
+    cluster.run_until_idle()
+    # the replacement (node 4, the only non-member) bootstrapped the data
+    assert cluster.stores[4].get(k(10)) == ("v1",)
+    assert cluster.stores[4].get(k(900)) == ("v2",)
+    # post-handoff traffic converges on the new replica set
+    w2 = write(cluster, 1, {10: "v3"})
+    assert cluster.run_until(w2.is_done)
+    cluster.run_until_idle()
+    lists = {cluster.stores[n].get(k(10)) for n in (1, 2, 4)}
+    assert lists == {("v1", "v3")}, lists
+
+
+def test_decommission_refuses_without_replacement():
+    """Every live node already replicates the shard: no hand-off target —
+    decommission returns None and changes nothing."""
+    cluster = make_cluster(nodes=(1, 2, 3), seed=19)
+    epoch = cluster.topologies[-1].epoch
+    assert cluster.decommission(2) is None
+    assert cluster.topologies[-1].epoch == epoch
+    assert 2 not in cluster.decommissioned
+
+
+# ---------------------------------------------------------------------------
+# TopologyRandomizer elastic mutations + MembershipNemesis
+# ---------------------------------------------------------------------------
+
+def test_randomizer_join_spawns_from_pool_and_leave_drains():
+    cluster = make_cluster(nodes=(1, 2, 3), seed=23, extra_nodes=[4])
+    cluster.run_until_idle()
+    randomizer = TopologyRandomizer(cluster, RandomSource(3), elastic=True,
+                                    spawn_pool=[5, 6])
+    current = cluster.topologies[-1]
+    new_shards = randomizer._join(list(current.shards), current)
+    assert new_shards is not None
+    members = {n for s in new_shards for n in s.nodes}
+    newcomer = members - current.nodes()
+    assert len(newcomer) == 1
+    # an existing live non-member (4) is preferred over spawning
+    assert newcomer == {4}
+    cluster.update_topology(Topology(current.epoch + 1, new_shards))
+    cluster.run_until_idle()
+
+    # leave: with 4 members and rf 3 someone can be spared
+    current = cluster.topologies[-1]
+    out = randomizer._leave(list(current.shards), current)
+    if out is not None:
+        after = {n for s in out for n in s.nodes}
+        assert len(current.nodes() - after) <= 1
+
+
+def test_membership_nemesis_cycles_under_load():
+    """Seeded join/decommission cycles on a burn: members change, every op
+    resolves, final replica sets agree (run_burn's end checks)."""
+    cfg = replace(LocalConfig(), membership_interval_s=3.0)
+    result = run_burn(1, ops=80, concurrency=10, chaos=True,
+                      allow_failures=True, topology_churn=True,
+                      elastic_membership=True, durability=True, journal=True,
+                      node_config=cfg, stall_watchdog_s=120.0,
+                      max_tasks=40_000_000)
+    assert result.resolved == 80
+    assert result.joins >= 1, result
+    assert result.leaves >= 1, result
+
+
+def test_elastic_burn_deterministic_and_recorder_invisible():
+    """Same-seed elastic burn twice: byte-identical message traces; and the
+    flight recorder on vs off stays byte-identical too (zero observer effect
+    extends to the membership plane)."""
+    from cassandra_accord_tpu.observe import FlightRecorder
+    cfg = replace(LocalConfig(), membership_interval_s=3.0)
+    kw = dict(ops=60, concurrency=10, chaos=True, allow_failures=True,
+              topology_churn=True, elastic_membership=True, durability=True,
+              journal=True, node_config=cfg, max_tasks=40_000_000)
+    ta, tb, tc = Trace(), Trace(), Trace()
+    a = run_burn(2, tracer=ta.hook, **kw)
+    b = run_burn(2, tracer=tb.hook, **kw)
+    assert diff_traces(ta, tb) is None
+    c = run_burn(2, tracer=tc.hook, observer=FlightRecorder(), **kw)
+    assert diff_traces(ta, tc) is None, \
+        "the flight recorder perturbed an elastic-membership burn"
+    assert (a.ops_ok, a.joins, a.leaves, a.sim_micros) == \
+           (c.ops_ok, c.joins, c.leaves, c.sim_micros)
+
+
+def test_elastic_gray_failure_burn():
+    """Elastic membership composed with the gray-failure axes (crash-restart
+    + pause + disk stall): joins/leaves interleave with kills and every op
+    still resolves."""
+    cfg = replace(LocalConfig(), membership_interval_s=4.0,
+                  restart_interval_s=6.0, pause_interval_s=5.0,
+                  disk_stall_interval_s=7.0)
+    result = run_burn(4, ops=80, concurrency=10, chaos=True,
+                      allow_failures=True, topology_churn=True,
+                      elastic_membership=True, durability=True, journal=True,
+                      restart_nodes=True, pause_nodes=True, disk_stall=True,
+                      node_config=cfg, stall_watchdog_s=150.0,
+                      max_tasks=80_000_000)
+    assert result.resolved == 80
+    assert result.joins + result.leaves >= 1, result

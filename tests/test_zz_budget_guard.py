@@ -1,0 +1,40 @@
+"""Tier-1 wall-clock budget guard.
+
+The verify pipeline runs the default test selection under a hard
+``timeout -k 10 870`` (ROADMAP "Tier-1 verify").  A selection that creeps
+past the budget dies as an opaque timeout kill — mid-file, with no signal
+about WHICH additions ate the margin.  This file sorts LAST in the default
+alphabetical collection order (``-p no:randomly``), so by the time it runs
+every other tier-1 test has finished: asserting on the elapsed session
+wall-clock here turns budget creep into a loud, attributable test failure
+while there is still margin to act on.
+
+The threshold leaves headroom below the 870s ceiling for collection,
+interpreter startup, and machine variance; the measured post-round-9
+baseline is ~230-260s (seed baseline 207s + the seed-6 regression burn and
+the membership suite).
+"""
+import os
+import time
+
+# 870s hard ceiling minus margin for startup/teardown/variance.  If this
+# fires: profile `--durations=20`, then either speed up the new tests or
+# gate the heavyweight ones behind ACCORD_LONG_BURNS.
+TIER1_BUDGET_S = 870
+GUARD_THRESHOLD_S = 700
+
+
+def test_tier1_selection_within_wall_clock_budget(request):
+    if os.environ.get("ACCORD_LONG_BURNS"):
+        # the gated long-burn selection is hours-class by design
+        return
+    t0 = getattr(request.config, "_accord_session_t0", None)
+    if t0 is None:
+        # collected without the repo conftest (exotic invocation): no stamp
+        return
+    elapsed = time.monotonic() - t0
+    assert elapsed < GUARD_THRESHOLD_S, (
+        f"tier-1 selection took {elapsed:.0f}s before the budget guard ran — "
+        f"within {TIER1_BUDGET_S - elapsed:.0f}s of the verify pipeline's "
+        f"{TIER1_BUDGET_S}s hard timeout.  Profile with --durations=20 and "
+        f"trim or gate (ACCORD_LONG_BURNS) the heavyweight additions.")
