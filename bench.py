@@ -377,7 +377,8 @@ def _strip_axon_and_go_cpu():
         p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
         if p and "axon" not in p) or os.path.dirname(os.path.abspath(__file__))
     import sys
-    os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)],
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
               os.environ)
 
 
@@ -485,6 +486,39 @@ def stage(name: str, fn):
         return None
 
 
+def smoke_main():
+    """``bench.py --smoke``: the seconds-class fixed-seed measurement the
+    perf gate runs — full protocol burn + critical-path latency budget +
+    wall profile — honoring the same fail-open staging and stdout TAIL
+    contract as the full bench (the LAST stdout line is one compact
+    single-line JSON object; tests/test_bench_smoke.py pins this)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGALRM, _on_term)
+    signal.alarm(max(60, int(DEADLINE - time.monotonic()) - 30))
+    d = RESULT["detail"]
+
+    def smoke():
+        from tools.perfgate import measure_smoke
+        summary = measure_smoke()
+        d["smoke"] = summary
+        RESULT["metric"] = "smoke_commit_latency_mean_us"
+        RESULT["unit"] = "sim_us"
+        RESULT["value"] = summary["sim"]["commit_latency_mean_us"]
+        d["headline_tier"] = summary["dominating_class"]
+    stage("smoke", smoke)
+    d["incomplete"] = "smoke" not in d
+    emit_and_exit(0)
+
+
+def gate_main():
+    """``bench.py --gate``: run the smoke measurement and compare against
+    BASELINE.json's gate block (tools/perfgate.py) — per-metric deltas on
+    stdout, exit nonzero past thresholds.  Only deterministic SIM-time
+    metrics gate; wall-clock numbers are printed for the log."""
+    from tools.perfgate import run
+    raise SystemExit(run(gate=True))
+
+
 def main():
     signal.signal(signal.SIGTERM, _on_term)
     signal.signal(signal.SIGALRM, _on_term)
@@ -558,21 +592,11 @@ def main():
         from cassandra_accord_tpu.harness.burn import run_burn
         from cassandra_accord_tpu.observe import InvariantAuditor
         from cassandra_accord_tpu.observe import schema as _schema
+        from cassandra_accord_tpu.observe.registry import Histogram
 
-        def pct(snapshot, q):
-            """Percentile estimate from a fixed-bound histogram: upper bound
-            of the bucket containing the q-quantile (conservative)."""
-            total = snapshot["count"]
-            if not total:
-                return None
-            need = q * total
-            acc = 0
-            bounds = snapshot["bounds"]
-            for i, n in enumerate(snapshot["buckets"]):
-                acc += n
-                if acc >= need:
-                    return bounds[i] if i < len(bounds) else None
-            return None
+        # percentile estimate from a fixed-bound histogram snapshot: the
+        # registry's conservative bucket-upper-bound formula
+        pct = Histogram.snapshot_percentile
 
         out = {}
         cfg = _replace(LocalConfig(), membership_interval_s=6.0)
@@ -722,4 +746,20 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+    _p = argparse.ArgumentParser(description=__doc__)
+    _p.add_argument("--smoke", action="store_true",
+                    help="seconds-class fixed-seed smoke measurement "
+                         "(protocol burn + latency budget); same last-line "
+                         "single-JSON tail contract as the full bench")
+    _p.add_argument("--gate", action="store_true",
+                    help="smoke measurement + regression gate vs "
+                         "BASELINE.json (tools/perfgate.py): prints "
+                         "per-metric deltas, exits nonzero past thresholds")
+    _args = _p.parse_args()
+    if _args.gate:
+        gate_main()
+    elif _args.smoke:
+        smoke_main()
+    else:
+        main()

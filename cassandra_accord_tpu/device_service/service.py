@@ -114,6 +114,9 @@ class DeviceConsultService:
         # scheduling, so the zero-observer-effect contract holds.
         self.samples: List[Tuple[int, int, int]] = []
         self._sample_cap = 4096
+        # wall-clock profiler (observe.WallProfiler) — resolved lazily from
+        # the owning node at first dispatch; False = probed, none attached
+        self._profiler = None
 
     # -- clock (sim time when available) -------------------------------------
     def _now(self) -> Optional[int]:
@@ -263,9 +266,18 @@ class DeviceConsultService:
         """One launch: ragged batch in, (deps [rows, T] bool, max_lanes
         [rows, 5]) out — counters incremented ONCE PER SUBMITTED CONSULT
         (batch.rows), never per launch (the r03 bookkeeping fix)."""
+        if self._profiler is None:
+            node = getattr(getattr(self.resolver, "store", None), "node", None)
+            self._profiler = getattr(node, "profiler", None) or False
         t0 = time.perf_counter()
+        compiled = False
+        kt_shape = None
         if buffers is not None:
+            k, t = buffers["live_T"].shape
+            kt_shape = (t, k)
+            n_shapes = len(self.jit_shapes)
             deps, max_lanes = self._dispatch_jax(batch, buffers)
+            compiled = len(self.jit_shapes) > n_shapes
             self.resolver.device_consults += batch.rows
         else:
             h = self.resolver.host_index()
@@ -284,6 +296,17 @@ class DeviceConsultService:
         self.dispatch_max_seconds = max(self.dispatch_max_seconds, dt)
         self.occupancy_sum += batch.rows
         self._sample(0, batch.rows)
+        if self._profiler and buffers is not None:
+            # launch breakdown for the wall profiler: dispatch RTT, h2d
+            # (the ragged batch arrays) and d2h (densified results) bytes,
+            # and whether this launch compiled a new jit shape.  Wall-plane
+            # only — nothing here feeds the deterministic registry.
+            h2d = (batch.flat_cols.nbytes + batch.row_ids.nbytes
+                   + batch.weights.nbytes + batch.before.nbytes
+                   + batch.kind.nbytes)
+            self._profiler.on_device_launch(
+                batch.rows, dt, h2d, deps.nbytes + max_lanes.nbytes,
+                compiled, shape=kt_shape)
         return deps[:batch.rows], max_lanes[:batch.rows]
 
     def _dispatch_jax(self, batch: ConsultBatch, buffers):

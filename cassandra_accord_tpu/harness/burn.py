@@ -149,6 +149,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              max_tasks: int = 20_000_000,
              tracer=None, on_submit=None, consult_recorder=None,
              observer=None,
+             profiler=None,
              audit: str = "off",
              audit_slo_s: Optional[float] = None,
              progress_every_s: Optional[float] = None,
@@ -189,6 +190,13 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     Chrome-trace export.  ZERO observer effect: a same-seed run with and
     without one yields byte-identical message traces (proven by
     tests/test_observe.py).
+
+    ``profiler``: an ``observe.WallProfiler`` — the WALL-CLOCK plane
+    (per-message-type handler CPU, event-loop occupancy + queue depth,
+    device-service launch breakdown).  Explicitly outside the determinism
+    contract (its numbers differ run to run) but equally forbidden from
+    perturbing the sim: the recorder trace stays byte-identical with it on
+    vs off (tests/test_profiler.py).
 
     ``progress_every_s``: heartbeat — print one progress line (ops resolved,
     in-flight, fast-path share) per this many SIM-seconds, so long seed
@@ -257,7 +265,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                       progress_poll_s=progress_poll_s,
                       batch_window_us=batch_window_us,
                       node_config=node_config,
-                      observer=observer)
+                      observer=observer, profiler=profiler)
     cluster.tracer = tracer
     if consult_recorder is not None:
         # trace-driven data-plane bench (harness/consult_trace.py): wrap every
@@ -742,6 +750,9 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         tel = cluster_resolver_totals(cluster)
         if any(tel.values()):
             result.stats.update({f"resolver_{k2}": v for k2, v in tel.items()})
+        if profiler is not None:
+            # pull the resolver-side wall counters (consult_wall_s totals)
+            profiler.collect_cluster(cluster)
         if observer is not None:
             # end-of-run pull collection: simulator stats, per-store gauges,
             # resolver counters — one registry for burns AND bench reporting
@@ -792,6 +803,11 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 for store in node.command_stores.all_stores():
                     cluster.journal.verify_against(store)
     except BaseException as e:  # noqa: BLE001
+        if profiler is not None:
+            try:
+                profiler.collect_cluster(cluster)
+            except Exception:  # noqa: BLE001 — never mask the real failure
+                pass
         if observer is not None:
             # the recording is most valuable on a FAILED seed: pull-collect
             # the cluster gauges so the artifacts written by the CLI's
@@ -924,6 +940,18 @@ def main(argv=None) -> None:
                    help="write the flight recorder's Chrome trace-event "
                         "JSON (open in Perfetto / chrome://tracing; one "
                         "track per node/store) after every seed")
+    p.add_argument("--profile", action="store_true",
+                   help="two-plane performance profile per seed: the "
+                        "sim-time critical-path latency budget (which "
+                        "segment classes a commit's life is spent in — "
+                        "observe/critical_path.py) and the wall-clock "
+                        "profile (per-message-type handler CPU, event-loop "
+                        "occupancy, device launch breakdown — "
+                        "observe/profiler.py).  Rides the flight recorder; "
+                        "zero effect on the recorded trace.  With --json "
+                        "both reports land in the per-seed entry; with "
+                        "--trace-out the wall handler tracks + txn flow "
+                        "links are embedded in the Perfetto trace")
     p.add_argument("--progress", type=float, default=None, metavar="SIM_S",
                    help="heartbeat: one progress line (resolved, in-flight, "
                         "fast-path %%) per SIM_S sim-seconds")
@@ -969,12 +997,13 @@ def main(argv=None) -> None:
         stem, ext = _p.splitext(path)
         return f"{stem}.seed{seed}{ext or '.json'}"
 
-    if args.reconcile and (args.metrics_out or args.trace_out):
+    if args.reconcile and (args.metrics_out or args.trace_out or args.profile):
         # reconcile runs two bare runs per seed and diffs them; a flight
         # recorder would conflate both into one recording — say so up front
         # instead of silently never writing the files
-        print("warning: --metrics-out/--trace-out are ignored with "
-              "--reconcile (no artifacts will be written)", flush=True)
+        print("warning: --metrics-out/--trace-out/--profile are ignored with "
+              "--reconcile (no artifacts/profiles will be produced)",
+              flush=True)
 
     def write_json() -> None:
         if args.json is None:
@@ -1028,24 +1057,33 @@ def main(argv=None) -> None:
             from ..observe import InvariantAuditor
             observer = InvariantAuditor(
                 mode=args.audit, slo_unattended_s=args.audit_slo,
-                record_messages=bool(args.trace_out))
+                record_messages=bool(args.trace_out or args.profile))
             kw["observer"] = observer
             kw["audit"] = args.audit
         elif args.audit != "off" and args.reconcile:
             kw["audit"] = args.audit
             kw["audit_slo_s"] = args.audit_slo
-        elif (args.metrics_out or args.trace_out) and not args.reconcile:
+        elif (args.metrics_out or args.trace_out or args.profile) \
+                and not args.reconcile:
             # flight recorder (reconcile runs its own two bare runs: the
             # recorder would conflate them, so it stays off there — warned
-            # once before the loop)
+            # once before the loop).  --profile keeps the message timeline:
+            # the critical-path extractor uses PreAccept RECV events to
+            # split network wait from replica queueing
             from ..observe import FlightRecorder
-            observer = FlightRecorder(record_messages=bool(args.trace_out))
+            observer = FlightRecorder(
+                record_messages=bool(args.trace_out or args.profile))
             kw["observer"] = observer
+        profiler = None
+        if args.profile and not args.reconcile:
+            from ..observe import WallProfiler
+            profiler = WallProfiler()
+            kw["profiler"] = profiler
         if args.progress:
             kw.update(progress_every_s=args.progress,
                       progress_label=f"seed {seed}")
 
-        def write_artifacts(observer=observer, seed=seed):
+        def write_artifacts(observer=observer, seed=seed, profiler=profiler):
             if observer is None:
                 return
             import json as _json
@@ -1055,7 +1093,25 @@ def main(argv=None) -> None:
                                sort_keys=True)
                     f.write("\n")
             if args.trace_out:
-                observer.write_trace(artifact_path(args.trace_out, seed))
+                # the wall handler tracks + sim→wall txn flow links ride
+                # along whenever the profiler ran
+                observer.write_trace(artifact_path(args.trace_out, seed),
+                                     profiler=profiler)
+
+        def profile_reports(entry, observer=observer, profiler=profiler,
+                            seed=seed):
+            """--profile: compute/print both planes, enrich the --json entry.
+            Runs on success AND failure (the budget of a stalled seed is the
+            forensic artifact)."""
+            if profiler is None or observer is None:
+                return
+            from ..observe import format_budget, format_wall_profile
+            budget = observer.latency_budget()
+            wall = profiler.report()
+            entry["latency_budget"] = budget
+            entry["wall_profile"] = wall
+            print(format_budget(budget, label=f"seed {seed}"), flush=True)
+            print(format_wall_profile(wall, label=f"seed {seed}"), flush=True)
         t0 = _time.perf_counter()
         entry = {"seed": seed, "rf": rf, "ops": args.ops}
         summaries.append(entry)
@@ -1089,6 +1145,7 @@ def main(argv=None) -> None:
                 if getattr(result, "audit", None) is not None:
                     # per-seed audit verdict: violations + SLO flags
                     entry["audit"] = result.audit
+                profile_reports(entry)
                 write_artifacts()
                 write_json()
                 print(f"seed {seed}: {result!r} (rf={rf}, "
@@ -1111,6 +1168,10 @@ def main(argv=None) -> None:
                 entry["audit"] = e.audit
             # the flight recording is MOST valuable on a failed seed: write
             # whatever was captured up to the failure point
+            try:
+                profile_reports(entry)
+            except Exception:  # noqa: BLE001 — never mask the real failure
+                pass
             write_artifacts()
             write_json()
             if isinstance(e.cause, StallError):

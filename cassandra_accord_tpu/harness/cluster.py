@@ -617,7 +617,8 @@ class Cluster:
                  resolver: Optional[str] = None,
                  batch_window_us: int = 0,
                  node_config=None,
-                 observer=None):
+                 observer=None,
+                 profiler=None):
         self.rng = RandomSource(seed)
         self.queue = PendingQueue()
         self.scheduler = SimScheduler(self.queue)
@@ -630,6 +631,11 @@ class Cluster:
         # fed from the same sites as the tracer plus the lifecycle planes;
         # MUST have zero observer effect (no RNG, no wall clock, no scheduling)
         self.observer = observer
+        # wall-clock profiler (observe.WallProfiler): times handler CPU and
+        # event-loop occupancy.  Reads wall clocks ONLY — it must never
+        # touch RNG, sim scheduling, or the message path, so the recorder
+        # trace stays byte-identical with it on vs off (tests/test_profiler)
+        self.profiler = profiler
         if observer is not None and hasattr(observer, "attach_cluster"):
             # the InvariantAuditor reads cluster state (node epochs, the
             # epoch-sync ledger) passively for its monotonicity rules
@@ -776,8 +782,10 @@ class Cluster:
         finally:
             svc.boot_cap = None
         # flight-recorder wiring (survives restarts: every rebuilt incarnation
-        # reports into the same run-wide recorder)
+        # reports into the same run-wide recorder); the wall profiler rides
+        # the same lifecycle
         node.observer = self.observer
+        node.profiler = self.profiler
         return node
 
     # -- pause lifecycle (the pause nemesis substrate) ------------------------
@@ -1326,11 +1334,17 @@ class Cluster:
         """Drain the queue until only recurring tasks remain; returns tasks
         executed. Raises any node failure."""
         n = 0
+        profiler = self.profiler
         while n < max_tasks and self.queue.has_nonrecurring():
             task = self.queue.pop()
             if task is None:
                 break
-            task()
+            if profiler is not None:
+                t0 = profiler.now()
+                task()
+                profiler.on_task(profiler.now() - t0, len(self.queue._heap))
+            else:
+                task()
             n += 1
             if self.failures:
                 raise self.failures[0]
@@ -1338,13 +1352,22 @@ class Cluster:
 
     def run_until(self, predicate: Callable[[], bool], max_tasks: int = 1_000_000) -> bool:
         n = 0
+        profiler = self.profiler
         while n < max_tasks:
             if predicate():
                 return True
             task = self.queue.pop()
             if task is None:
                 return predicate()
-            task()
+            if profiler is not None:
+                # event-loop occupancy plane: per-task wall cost + pending-
+                # queue depth (len of the raw heap is O(1); the cancelled-
+                # entry overcount is fine for a depth distribution)
+                t0 = profiler.now()
+                task()
+                profiler.on_task(profiler.now() - t0, len(self.queue._heap))
+            else:
+                task()
             n += 1
             if self.failures:
                 raise self.failures[0]

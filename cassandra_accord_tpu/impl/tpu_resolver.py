@@ -237,6 +237,10 @@ class TpuDepsResolver(DepsResolver):
         self.host_consults = 0
         self.native_consults = 0
         self.device_consults = 0
+        # total wall seconds inside fused-consult tier dispatch (whichever
+        # tier answered) — the wall profiler's device-consult-wait line.
+        # WALL-clock: never enters the deterministic registry or burn stats
+        self.consult_wall_s = 0.0
         # persistent batched device consult service (device_service/): owns
         # the device-resident index (incremental double-buffered refresh),
         # the ragged batching window, and the futures submission API.  The
@@ -964,15 +968,20 @@ class TpuDepsResolver(DepsResolver):
         B·T·K vs the calibrated launch-amortization threshold."""
         self._flush()
         b = q.shape[0]
-        if self.tier == "device" or (
-                self.tier == "auto"
-                and b * self._t * self._k >= self._device_threshold()):
-            if self.service_enabled:
-                # the persistent service: incremental buffer refresh + ragged
-                # launch (vs the legacy one-shot whole-index re-upload below)
-                return self.service().consult_rows(q, before, kind)
-            return self._consult_device(q, before, kind)
-        return self._consult_host(q, before, kind, want_deps, want_max)
+        t0 = time.perf_counter()
+        try:
+            if self.tier == "device" or (
+                    self.tier == "auto"
+                    and b * self._t * self._k >= self._device_threshold()):
+                if self.service_enabled:
+                    # the persistent service: incremental buffer refresh +
+                    # ragged launch (vs the legacy one-shot whole-index
+                    # re-upload below)
+                    return self.service().consult_rows(q, before, kind)
+                return self._consult_device(q, before, kind)
+            return self._consult_host(q, before, kind, want_deps, want_max)
+        finally:
+            self.consult_wall_s += time.perf_counter() - t0
 
     def _device_threshold(self) -> float:
         """elems = B·T·K above which the device tier wins: calibrated once

@@ -59,6 +59,11 @@ class Node(ConfigurationService.Listener):
         # must stay passive (zero observer effect): they may read sim state
         # but never touch RNG, wall clock, or scheduling.
         self.observer = None
+        # wall-clock profiler (observe.WallProfiler) — assigned by the
+        # harness cluster like the observer; explicitly OUTSIDE the
+        # determinism contract (it reads wall clocks) but still forbidden
+        # from perturbing the sim (no RNG, no scheduling, no message path)
+        self.profiler = None
         self.topology = TopologyManager(node_id)
         self._epoch_watchdogs: set = set()
         self.command_stores = CommandStores(self, num_shards, executor_factory)
@@ -268,11 +273,21 @@ class Node(ConfigurationService.Listener):
             self.agent.on_handled_exception(failure)
             self.message_sink.reply_with_unknown_failure(from_node, reply_context, failure)
             return
+        profiler = self.profiler
+        t_start = profiler.now() if profiler is not None else 0.0
         try:
             request.process(self, from_node, reply_context)
         except BaseException as e:  # noqa: BLE001 — must reply so the caller unblocks
             self.agent.on_handled_exception(e)
             self.message_sink.reply_with_unknown_failure(from_node, reply_context, e)
+        finally:
+            if profiler is not None:
+                # per-message-type handler CPU (wall plane): measured around
+                # the replica-side state machine, attributed to the txn so
+                # the Perfetto export can flow-link sim spans to host slices
+                profiler.on_handler(self.id, type(request).__name__,
+                                    getattr(request, "txn_id", None),
+                                    t_start, self._now_micros())
 
     def send(self, to: int, request: "Request", callback: Optional["Callback"] = None) -> None:
         if callback is None:

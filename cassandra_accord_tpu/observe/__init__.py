@@ -19,10 +19,16 @@ from .spans import TxnSpan, TxnSpanRecorder
 from .flight import FlightRecorder
 from .audit import AuditViolation, InvariantAuditor
 from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
+from .critical_path import (SEGMENT_CLASSES, extract_critical_paths,
+                            format_budget, latency_budget)
+from .profiler import WallProfiler, format_wall_profile
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "TxnSpan", "TxnSpanRecorder", "FlightRecorder",
     "AuditViolation", "InvariantAuditor",
     "chrome_trace", "validate_chrome_trace", "write_chrome_trace",
+    "SEGMENT_CLASSES", "extract_critical_paths", "format_budget",
+    "latency_budget",
+    "WallProfiler", "format_wall_profile",
 ]

@@ -125,3 +125,13 @@ def kernel_consult_metrics(t: int, k: int, b: int,
     return {"index_bytes_int8": index_bytes_int8(t, k),
             "device_join_tflops": round(tflops, 4),
             "consult_mfu_vs_275tflops": round(tflops / PEAK_BF16_TFLOPS, 5)}
+
+
+def launch_mfu(t: int, k: int, rows: int, seconds: float) -> Dict[str, float]:
+    """Honest MFU of one measured consult launch (the wall profiler's
+    per-launch plane): achieved join TFLOP/s of a [rows,K]x[K,T] join over
+    its measured wall seconds, against the bf16 peak — same denominator as
+    ``kernel_consult_metrics``, one formula source for bench and profiler."""
+    tflops = consult_join_flops(max(rows, 1), k, t) / max(seconds, 1e-9) / 1e12
+    return {"launch_join_tflops": round(tflops, 5),
+            "launch_mfu_vs_275tflops": round(tflops / PEAK_BF16_TFLOPS, 7)}

@@ -29,10 +29,15 @@ from __future__ import annotations
 import json
 from typing import List
 
-_VALID_PHASES = {"X", "i", "M", "B", "E", "C"}
+_VALID_PHASES = {"X", "i", "M", "B", "E", "C", "s", "t", "f"}
+_FLOW_PHASES = {"s", "t", "f"}
 
 # synthetic pid for cluster-wide counter tracks (real nodes are 1-based)
 COUNTER_PID = 0
+# synthetic pid for the wall-clock profiler tracks (observe/profiler.py):
+# timestamps on this process are WALL micros since profiler start — a
+# different time base from the sim tracks, linked per-txn by flow events
+WALL_PID = 9999
 _COUNTER_BUCKETS = 256
 
 
@@ -113,6 +118,48 @@ def service_counter_events(recorder,
     return events
 
 
+def wall_profile_events(recorder, profiler) -> List[dict]:
+    """Plane-2 tracks: one ``X`` slice per recorded handler invocation on
+    the synthetic wall-clock process (pid ``WALL_PID``, tid = node id,
+    timestamps in WALL micros since profiler start), plus FLOW events
+    (``s``/``t``/``f``) linking each client txn's sim-time span (on its
+    coordinator track) to the host handler slices that served it — the
+    two-time-base bridge: click a txn, follow the flow to the wall plane."""
+    if profiler is None or not profiler.slices:
+        return []
+    events: List[dict] = []
+    # handler slices, and per-txn wall slices for flow binding
+    by_txn: dict = {}
+    for i, (type_name, node, tid_str, wall_us, dur_us, sim_us) in \
+            enumerate(profiler.slices):
+        events.append({"name": type_name, "cat": "wall_handler", "ph": "X",
+                       "ts": wall_us, "dur": dur_us, "pid": WALL_PID,
+                       "tid": node,
+                       "args": {"txn_id": tid_str, "sim_us": sim_us}})
+        if tid_str is not None:
+            by_txn.setdefault(tid_str, []).append((wall_us, node))
+    for span in recorder.spans.spans.values():
+        if not span.is_client_op:
+            continue
+        slices = by_txn.get(str(span.txn_id))
+        if not slices:
+            continue
+        flow_id = f"txnflow-{span.txn_id}"
+        events.append({"name": "serves", "cat": "txnflow", "ph": "s",
+                       "id": flow_id, "ts": span.submitted_us,
+                       "pid": span.coordinator, "tid": 0,
+                       "args": {"txn_id": str(span.txn_id)}})
+        for j, (wall_us, node) in enumerate(slices):
+            ph = "f" if j + 1 == len(slices) else "t"
+            ev = {"name": "serves", "cat": "txnflow", "ph": ph,
+                  "id": flow_id, "ts": wall_us, "pid": WALL_PID, "tid": node,
+                  "args": {"txn_id": str(span.txn_id)}}
+            if ph == "f":
+                ev["bp"] = "e"   # bind to the enclosing handler slice
+            events.append(ev)
+    return events
+
+
 def _span_events(span) -> List[dict]:
     events: List[dict] = []
     tid_str = str(span.txn_id)
@@ -145,8 +192,11 @@ def _span_events(span) -> List[dict]:
     return events
 
 
-def chrome_trace(recorder, include_messages: bool = True) -> dict:
-    """Render a FlightRecorder as a Chrome trace-event JSON object."""
+def chrome_trace(recorder, include_messages: bool = True,
+                 profiler=None) -> dict:
+    """Render a FlightRecorder as a Chrome trace-event JSON object.
+    ``profiler`` (an ``observe.WallProfiler``) adds the wall-clock handler
+    tracks + per-txn flow links (``wall_profile_events``)."""
     events: List[dict] = []
     pids = set()
     tids = set()        # (pid, tid)
@@ -155,6 +205,10 @@ def chrome_trace(recorder, include_messages: bool = True) -> dict:
             pids.add(ev["pid"])
             tids.add((ev["pid"], ev["tid"]))
             events.append(ev)
+    for ev in wall_profile_events(recorder, profiler):
+        pids.add(ev["pid"])
+        tids.add((ev["pid"], ev["tid"]))
+        events.append(ev)
     counters = counter_events(recorder)
     if counters:
         pids.add(COUNTER_PID)
@@ -176,12 +230,19 @@ def chrome_trace(recorder, include_messages: bool = True) -> dict:
                                     "msg_id": msg_id}})
     meta: List[dict] = []
     for pid in sorted(pids):
-        pname = "cluster counters" if pid == COUNTER_PID else f"node {pid}"
+        if pid == COUNTER_PID:
+            pname = "cluster counters"
+        elif pid == WALL_PID:
+            pname = "host wall-clock (profiler)"
+        else:
+            pname = f"node {pid}"
         meta.append({"name": "process_name", "ph": "M", "ts": 0, "pid": pid,
                      "tid": 0, "args": {"name": pname}})
     for pid, tid in sorted(tids):
         if pid == COUNTER_PID:
             name = "counters" if tid == 0 else "consult service"
+        elif pid == WALL_PID:
+            name = f"node {tid} handlers (wall)"
         else:
             name = "coordinator" if tid == 0 else f"store {tid - 1}"
         meta.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
@@ -195,9 +256,11 @@ def chrome_trace(recorder, include_messages: bool = True) -> dict:
 
 
 def write_chrome_trace(path: str, recorder,
-                       include_messages: bool = True) -> None:
+                       include_messages: bool = True,
+                       profiler=None) -> None:
     with open(path, "w") as f:
-        json.dump(chrome_trace(recorder, include_messages=include_messages),
+        json.dump(chrome_trace(recorder, include_messages=include_messages,
+                               profiler=profiler),
                   f, sort_keys=True)
         f.write("\n")
 
@@ -228,6 +291,8 @@ def validate_chrome_trace(doc) -> List[str]:
             dur = ev.get("dur")
             if not isinstance(dur, int) or dur <= 0:
                 problems.append(f"{ctx}: X event needs a positive int dur")
+        if ph in _FLOW_PHASES and not ev.get("id"):
+            problems.append(f"{ctx}: flow event ({ph}) needs an id")
         if ph == "C":
             args = ev.get("args")
             if not isinstance(args, dict) or not args:

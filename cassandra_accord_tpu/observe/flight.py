@@ -169,17 +169,18 @@ class FlightRecorder:
         reg = self.registry
         for key, value in cluster.stats.items():
             reg.gauge(f"sim.{key}").set(value)
+        sg = schema.STORE_GAUGE_METRICS   # names live in the unit-linted schema
         for node in cluster.nodes.values():
             for cs in node.command_stores.all_stores():
-                reg.gauge("store.commands", node=node.id,
+                reg.gauge(sg["commands"], node=node.id,
                           store=cs.id).set(len(cs.commands))
-                reg.gauge("store.cold", node=node.id,
+                reg.gauge(sg["cold"], node=node.id,
                           store=cs.id).set(len(cs.cold))
-                reg.gauge("store.exec_deferred", node=node.id,
+                reg.gauge(sg["exec_deferred"], node=node.id,
                           store=cs.id).set(len(cs.exec_deferred))
-                reg.gauge("store.cache_miss_loads", node=node.id,
+                reg.gauge(sg["cache_miss_loads"], node=node.id,
                           store=cs.id).set(cs.cache_miss_loads)
-                reg.gauge("store.tfk_inversions", node=node.id,
+                reg.gauge(sg["tfk_inversions"], node=node.id,
                           store=cs.id).set(cs.tfk_inversions)
         device_metrics.collect_into(reg, cluster)
         samples: list = []
@@ -199,10 +200,16 @@ class FlightRecorder:
             self.collect_cluster(cluster)
         return self.registry.to_json()
 
-    def chrome_trace(self) -> dict:
+    def chrome_trace(self, profiler=None) -> dict:
         from .export import chrome_trace
-        return chrome_trace(self)
+        return chrome_trace(self, profiler=profiler)
 
-    def write_trace(self, path: str) -> None:
+    def write_trace(self, path: str, profiler=None) -> None:
         from .export import write_chrome_trace
-        write_chrome_trace(path, self)
+        write_chrome_trace(path, self, profiler=profiler)
+
+    def latency_budget(self, top_k: int = 6) -> dict:
+        """Plane-1 critical-path latency budget over the recorded spans
+        (observe/critical_path.py) — post-hoc analysis, no runtime cost."""
+        from .critical_path import latency_budget
+        return latency_budget(self, top_k=top_k)

@@ -78,6 +78,27 @@ class Histogram:
         return {"count": self.count, "total": self.total,
                 "bounds": list(self.bounds), "buckets": list(self.counts)}
 
+    @staticmethod
+    def snapshot_percentile(snapshot: dict, q: float) -> Optional[int]:
+        """Conservative percentile estimate from a snapshot dict: the upper
+        bound of the bucket containing the q-quantile (None when empty or
+        when the quantile lands in the overflow bucket).  One formula for
+        bench.py's SLO stages and the perf-gate reports."""
+        total = snapshot["count"]
+        if not total:
+            return None
+        need = q * total
+        acc = 0
+        bounds = snapshot["bounds"]
+        for i, n in enumerate(snapshot["buckets"]):
+            acc += n
+            if acc >= need:
+                return bounds[i] if i < len(bounds) else None
+        return None
+
+    def percentile(self, q: float) -> Optional[int]:
+        return self.snapshot_percentile(self.to_snapshot(), q)
+
 
 class MetricsRegistry:
     """One flat registry; metrics are addressed by (scope, name).

@@ -140,6 +140,59 @@ SERVICE_STAT_METRICS = {
 SERVICE_BATCH_SIZE_METRIC = "service.batch_size"
 
 
+# -- store-scope pull-collected gauges (FlightRecorder.collect_cluster) ------
+
+STORE_GAUGE_METRICS = {
+    "commands": "store.commands",
+    "cold": "store.cold",
+    "exec_deferred": "store.exec_deferred",
+    "cache_miss_loads": "store.cache_miss_loads",
+    "tfk_inversions": "store.tfk_inversions",
+}
+
+# -- unit / time-plane declarations -------------------------------------------
+# Every HISTOGRAM and GAUGE metric declares its unit, which doubles as its
+# time-plane declaration: ``sim_s`` values are simulated time (deterministic,
+# diffable across same-seed runs), ``wall_s`` is host time (NEVER allowed in
+# the registry — snapshots are diffed across same-seed runs; the wall plane
+# lives in observe/profiler.py reports), ``bytes`` / ``count`` are plane-free
+# magnitudes.  Two-way linted (tests/test_observe.py) against the metric
+# tables above, exactly like the MessageType / SaveStatus completeness
+# checks: a new gauge/histogram without a unit fails tier-1, and so does a
+# stale unit entry for a removed metric.  ``sim.*`` gauges mirror dynamic
+# simulator-stat keys (message-class counts, fault injections) and are
+# covered by the prefix table.
+
+UNITS = ("sim_s", "wall_s", "bytes", "count")
+
+METRIC_UNITS = {
+    LATENCY_METRIC: "sim_s",
+    SERVICE_BATCH_SIZE_METRIC: "count",
+    **{name: "count" for name in RESOLVER_METRICS.values()},
+    **{name: "count" for name in SERVICE_STAT_METRICS.values()},
+    **{name: "count" for name in STORE_GAUGE_METRICS.values()},
+}
+
+METRIC_UNIT_PREFIXES = {
+    "sim.": "count",        # pull-collected cluster.stats mirror (dynamic)
+}
+
+
+def unit_for(metric_name: str) -> str:
+    """Declared unit/time-plane for a gauge or histogram metric; KeyError
+    (with the fix) for an undeclared one — the lint test turns that into a
+    tier-1 failure."""
+    unit = METRIC_UNITS.get(metric_name)
+    if unit is not None:
+        return unit
+    for prefix, unit in METRIC_UNIT_PREFIXES.items():
+        if metric_name.startswith(prefix):
+            return unit
+    raise KeyError(
+        f"metric {metric_name!r} declares no unit/time plane: add it to "
+        f"observe/schema.py METRIC_UNITS (sim_s | wall_s | bytes | count)")
+
+
 def metric_for_message(type_name: str) -> str:
     """Registry name for a MessageType member; KeyError (with the fix) for an
     unregistered one — the lint test turns that into a tier-1 failure."""

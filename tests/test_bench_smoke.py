@@ -1,0 +1,52 @@
+"""bench.py stdout TAIL contract (tier-1).
+
+The driver harness captures only a bounded tail of bench stdout and parses
+its LAST line as JSON.  PR 4 fixed the overflow that nulled every
+BENCH_r0*.json but left the contract untested — this is the regression
+test, pinned on the fast ``--smoke`` mode so tier-1 stays seconds-class.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "bench.py")
+
+
+@pytest.fixture(scope="module")
+def smoke_run():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ACCORD_BENCH_DEADLINE_S="150")
+    proc = subprocess.run([sys.executable, BENCH, "--smoke"],
+                          capture_output=True, text=True, timeout=200,
+                          env=env, cwd=os.path.dirname(BENCH))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    return proc
+
+
+def test_smoke_last_stdout_line_is_single_json_object(smoke_run):
+    lines = [l for l in smoke_run.stdout.splitlines() if l.strip()]
+    assert lines, "bench --smoke printed nothing"
+    tail = json.loads(lines[-1])          # the harness's parse, exactly
+    assert isinstance(tail, dict)
+    # the compact summary carries the headline + per-stage health
+    assert tail["metric"] == "smoke_commit_latency_mean_us"
+    assert isinstance(tail["value"], (int, float)) and tail["value"] > 0
+    assert tail["stages"].get("smoke") == "ok"
+    assert tail["incomplete"] is False
+    # sized to survive a bounded tail capture (the full-detail object that
+    # overflowed r01-r04 was tens of KB)
+    assert len(lines[-1]) < 4096
+
+
+def test_smoke_emits_full_detail_object_before_tail(smoke_run):
+    lines = [l for l in smoke_run.stdout.splitlines() if l.strip()]
+    assert len(lines) >= 2
+    full = json.loads(lines[-2])
+    smoke = full["detail"]["smoke"]
+    # the measurement is the perfgate one: sim plane + budget + wall plane
+    assert smoke["sim"]["commits"] == smoke["workload"]["ops"]
+    assert smoke["attributed_share"] >= 0.95
+    assert smoke["dominating_class"]

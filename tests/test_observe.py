@@ -139,6 +139,55 @@ def test_metric_name_lookups_raise_actionably():
         schema.metric_for_save_status("BOGUS")
 
 
+def test_every_gauge_and_histogram_declares_unit_two_way():
+    """Unit/time-plane lint: every gauge/histogram metric the schema knows
+    declares its unit (sim_s | wall_s | bytes | count), and there are no
+    stale unit entries for removed metrics — two-way, like the MessageType
+    completeness check."""
+    known = ({schema.LATENCY_METRIC, schema.SERVICE_BATCH_SIZE_METRIC}
+             | set(schema.RESOLVER_METRICS.values())
+             | set(schema.SERVICE_STAT_METRICS.values())
+             | set(schema.STORE_GAUGE_METRICS.values()))
+    missing = sorted(known - set(schema.METRIC_UNITS))
+    assert not missing, \
+        f"gauge/histogram metrics with no unit declaration (add to " \
+        f"observe/schema.py METRIC_UNITS): {missing}"
+    stale = sorted(set(schema.METRIC_UNITS) - known)
+    assert not stale, f"stale METRIC_UNITS entries: {stale}"
+    bad = {k: v for k, v in schema.METRIC_UNITS.items()
+           if v not in schema.UNITS}
+    bad.update({k: v for k, v in schema.METRIC_UNIT_PREFIXES.items()
+                if v not in schema.UNITS})
+    assert not bad, f"units outside the {schema.UNITS} vocabulary: {bad}"
+    # wall-clock values are forbidden in the registry entirely: snapshots
+    # are diffed across same-seed runs (the wall plane lives in
+    # observe/profiler.py reports)
+    walls = [k for k, v in schema.METRIC_UNITS.items() if v == "wall_s"]
+    assert not walls, f"wall-clock metrics registered in the deterministic " \
+                      f"registry: {walls}"
+
+
+def test_observed_burn_gauges_all_resolve_units():
+    """Every gauge/histogram a real instrumented burn actually registers
+    resolves through unit_for — dynamic sim.* mirrors included; an
+    undeclared metric raises actionably."""
+    from cassandra_accord_tpu.observe.registry import Gauge
+    rec = FlightRecorder()
+    run_burn(14, ops=20, concurrency=4, resolver="verify", observer=rec)
+    rec.metrics_snapshot()   # pull-collects the cluster gauges
+    seen = set()
+    for (_scope, name), metric in rec.registry._metrics.items():
+        if isinstance(metric, (Gauge, Histogram)):
+            seen.add(name)
+            schema.unit_for(name)   # raises KeyError on an undeclared one
+    assert schema.LATENCY_METRIC in seen
+    assert any(n.startswith("store.") for n in seen)
+    assert any(n.startswith("sim.") for n in seen)
+    assert schema.unit_for(schema.LATENCY_METRIC) == "sim_s"
+    with pytest.raises(KeyError, match="METRIC_UNITS"):
+        schema.unit_for("bogus.metric")
+
+
 # ---------------------------------------------------------------------------
 # trace ring buffer (satellite: bounded memory for long burns)
 # ---------------------------------------------------------------------------
@@ -397,6 +446,30 @@ def test_resolver_counters_unified_into_registry():
     store_scopes = [s for s in snap if s.startswith("store/")]
     assert any(schema.RESOLVER_METRICS["walk_consults"] in snap[s]
                for s in store_scopes)
+
+
+def test_histogram_percentile_estimate():
+    h = Histogram(bounds=(10, 100, 1000))
+    for v in (5, 5, 50, 500):
+        h.record(v)
+    assert h.percentile(0.50) == 10     # 2/4 inside the <=10 bucket
+    assert h.percentile(0.75) == 100
+    assert h.percentile(1.0) == 1000
+    assert Histogram(bounds=(10,)).percentile(0.5) is None
+    h.record(50_000)                    # overflow bucket: unbounded above
+    assert h.percentile(1.0) is None
+    # the snapshot-dict form is the same formula (bench.py protocol_slo)
+    assert Histogram.snapshot_percentile(h.to_snapshot(), 0.5) == 100
+
+
+def test_launch_mfu_formula():
+    from cassandra_accord_tpu.observe.device import (PEAK_BF16_TFLOPS,
+                                                     launch_mfu)
+    out = launch_mfu(t=1000, k=512, rows=256, seconds=0.001)
+    # 2*256*512*1000 FLOPs / 1ms = 0.262 TFLOP/s
+    assert out["launch_join_tflops"] == pytest.approx(0.2621, abs=1e-3)
+    assert out["launch_mfu_vs_275tflops"] == pytest.approx(
+        out["launch_join_tflops"] / PEAK_BF16_TFLOPS, abs=1e-6)
 
 
 def test_kernel_consult_metrics_formulas():

@@ -1,0 +1,2 @@
+"""Repo tooling (perf regression gate, etc.) — importable as ``tools.*``
+from the repo root, runnable as scripts."""

@@ -1,0 +1,267 @@
+"""perfgate — the commit-latency regression gate.
+
+Measures a small FIXED-SEED smoke workload through the full simulated
+cluster with the flight recorder + critical-path extractor attached, then
+compares the result against the recorded baseline (``BASELINE.json``'s
+``gate`` block) and — informationally — against the latest ``BENCH_r0*.json``
+headline.  Prints per-metric deltas; in ``--gate`` mode exits nonzero
+(``EXIT_REGRESSION``) when any GATED metric regresses past its threshold.
+
+What is gated vs merely printed:
+
+- **Gated: sim-time metrics.**  Simulated commit latency (mean/p95), total
+  sim duration, and the message volume of the fixed-seed workload are fully
+  deterministic — same code, same numbers, on any machine.  A change here
+  IS a protocol-behavior change (more round trips, longer dependency
+  chains), which is exactly what the gate exists to catch, with zero CI
+  flake risk.
+- **Printed only: wall-clock metrics.**  commits/s, handler CPU, event-loop
+  occupancy differ per machine; they are reported for the human reading the
+  log (the tier-1 budget guard prints them every verify run) but never
+  fail the gate.
+
+Self-test hook: ``ACCORD_PERFGATE_INJECT_LATENCY=<float>`` multiplies the
+measured sim latencies before comparison (``tests/test_perfgate.py`` uses
+2.0 to prove the gate trips on a 2x regression without doctoring the tree).
+
+Usage:
+    python tools/perfgate.py --smoke            # measure + print deltas, rc 0
+    python tools/perfgate.py --gate             # ... rc 3 past thresholds
+    python tools/perfgate.py --write-baseline   # refresh BASELINE.json gate
+    python bench.py --gate                      # same gate, bench entry point
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+BASELINE_PATH = os.path.join(_REPO_ROOT, "BASELINE.json")
+
+EXIT_REGRESSION = 3
+
+# the fixed smoke workload: deterministic, seconds-class, contended enough
+# that commit latency moves when the protocol's round structure changes
+SMOKE_SEED = 7
+SMOKE_KW = dict(ops=120, concurrency=16, nodes=3, rf=3, key_count=6,
+                durability=True, journal=True)
+
+# gated sim-time metrics: (key in summary["sim"], regression threshold as a
+# current/baseline ratio).  Latency thresholds are deliberately loose (1.5x)
+# — the gate is for "someone made commits take another round trip", not for
+# one-bucket jitter; sim metrics have NO run-to-run noise, so anything past
+# the threshold is a real behavior change.
+GATED_METRICS = (
+    ("commit_latency_mean_us", 1.5),
+    ("commit_latency_p95_us", 1.5),
+    ("sim_ms", 1.5),
+    ("messages", 1.5),
+)
+
+
+def measure_smoke() -> dict:
+    """Run the smoke workload; returns the gate summary (sim plane + wall
+    plane + the latency budget's class shares)."""
+    from cassandra_accord_tpu.harness.burn import run_burn
+    from cassandra_accord_tpu.observe import FlightRecorder, WallProfiler
+    rec = FlightRecorder()
+    prof = WallProfiler()
+    t0 = time.perf_counter()
+    res = run_burn(SMOKE_SEED, observer=rec, profiler=prof, **SMOKE_KW)
+    wall_s = time.perf_counter() - t0
+    budget = rec.latency_budget()
+    cluster_metrics = rec.metrics_snapshot()["cluster"]
+    messages = sum(v for k, v in cluster_metrics.items()
+                   if k.startswith("link.") and isinstance(v, int))
+    wall = prof.report()
+    inject = float(os.environ.get("ACCORD_PERFGATE_INJECT_LATENCY", "1.0"))
+    return {
+        "workload": dict(seed=SMOKE_SEED, **SMOKE_KW),
+        "sim": {
+            "commit_latency_mean_us":
+                round(budget["mean_commit_latency_us"] * inject, 1),
+            "commit_latency_p95_us": round(budget["p95_us"] * inject, 1),
+            "sim_ms": res.sim_micros // 1000,
+            "messages": messages,
+            "commits": res.ops_ok,
+        },
+        "budget_shares": {c: v["share"] for c, v in budget["classes"].items()},
+        "dominating_class": budget["dominating_class"],
+        "dominating_share": budget["dominating_share"],
+        "attributed_share": budget["attributed_share"],
+        "wall": {
+            "wall_s": round(wall_s, 3),
+            "commits_per_sec": round(res.ops_ok / wall_s, 1) if wall_s else None,
+            "handler_cpu_s": wall["handler_total_s"],
+            "loop_occupancy": wall["scheduler"]["occupancy"],
+        },
+    }
+
+
+def load_baseline(path: str = BASELINE_PATH) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f).get("gate")
+    except (OSError, ValueError):
+        return None
+
+
+def latest_bench(root: str = _REPO_ROOT) -> Optional[Tuple[str, dict]]:
+    """The newest BENCH_r0*.json artifact's parsed content, if any parses."""
+    names = sorted(n for n in os.listdir(root)
+                   if n.startswith("BENCH_r") and n.endswith(".json"))
+    for name in reversed(names):
+        try:
+            with open(os.path.join(root, name)) as f:
+                doc = json.load(f)
+            if doc:
+                return name, doc
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def compare(current: dict, baseline: Optional[dict]) \
+        -> Tuple[List[str], List[str]]:
+    """Per-metric delta lines + the list of gated failures."""
+    lines: List[str] = []
+    failures: List[str] = []
+    if baseline is None:
+        lines.append("perfgate: no baseline recorded (BASELINE.json has no "
+                     "'gate' block) — deltas unavailable, nothing gated")
+        cur = current["sim"]
+        for key, _thresh in GATED_METRICS:
+            lines.append(f"  {key:<26} {cur.get(key)}")
+        return lines, failures
+    base_sim = baseline.get("sim", {})
+    cur_sim = current["sim"]
+    lines.append(f"perfgate deltas vs baseline "
+                 f"(recorded {baseline.get('recorded', '?')}, sim plane is "
+                 f"deterministic):")
+    for key, thresh in GATED_METRICS:
+        cur, base = cur_sim.get(key), base_sim.get(key)
+        if cur is None or base is None:
+            lines.append(f"  {key:<26} {cur} (baseline {base}: not comparable)")
+            continue
+        if base == 0:
+            # a zero baseline cannot ratio: any nonzero current is a loud
+            # regression rather than a silent skip
+            if cur > 0:
+                failures.append(f"{key}: 0 -> {cur} (baseline is zero)")
+                lines.append(f"  {key:<26} 0 -> {cur}  ** REGRESSION "
+                             f"(zero baseline)")
+            else:
+                lines.append(f"  {key:<26} 0 -> 0  (1.000x)")
+            continue
+        ratio = cur / base
+        mark = ""
+        if ratio > thresh:
+            mark = f"  ** REGRESSION (> {thresh:.2f}x)"
+            failures.append(f"{key}: {base} -> {cur} ({ratio:.2f}x, "
+                            f"threshold {thresh:.2f}x)")
+        elif ratio < 1.0 / thresh:
+            mark = "  (improvement)"
+        lines.append(f"  {key:<26} {base} -> {cur}  ({ratio:.3f}x){mark}")
+    dom = current.get("dominating_class")
+    if dom:
+        lines.append(f"  commit budget: {dom} dominates at "
+                     f"{100.0 * current['dominating_share']:.1f}% "
+                     f"({100.0 * current['attributed_share']:.1f}% attributed)"
+                     + (f"; baseline {baseline.get('dominating_class')} at "
+                        f"{100.0 * baseline.get('dominating_share', 0):.1f}%"
+                        if baseline.get("dominating_class") else ""))
+    base_wall = baseline.get("wall", {})
+    cur_wall = current.get("wall", {})
+    if cur_wall.get("commits_per_sec"):
+        line = f"  wall (printed, never gated): " \
+               f"{cur_wall['commits_per_sec']} commits/s, " \
+               f"{cur_wall['handler_cpu_s']}s handler CPU, " \
+               f"occupancy {cur_wall['loop_occupancy']}"
+        if base_wall.get("commits_per_sec"):
+            line += f"  (baseline {base_wall['commits_per_sec']} commits/s)"
+        lines.append(line)
+    bench = latest_bench()
+    if bench is not None:
+        name, doc = bench
+        value = doc.get("value") or (doc.get("detail") or {}).get("value")
+        if value:
+            lines.append(f"  latest bench artifact {name}: "
+                         f"{doc.get('metric')} = {value}")
+    return lines, failures
+
+
+def run(gate: bool, baseline_path: str = BASELINE_PATH,
+        current: Optional[dict] = None, out=None) -> int:
+    """Measure (unless ``current`` given), print deltas, return the exit
+    code (0, or EXIT_REGRESSION when ``gate`` and a threshold tripped)."""
+    out = out or sys.stdout
+    if current is None:
+        current = measure_smoke()
+    lines, failures = compare(current, load_baseline(baseline_path))
+    for line in lines:
+        print(line, file=out, flush=True)
+    if failures:
+        verdict = "perfgate: " + ("FAIL — " if gate else "regressions "
+                                  "detected (print-only mode) — ") \
+            + "; ".join(failures)
+        print(verdict, file=out, flush=True)
+        return EXIT_REGRESSION if gate else 0
+    print("perfgate: PASS (no gated metric past threshold)", file=out,
+          flush=True)
+    return 0
+
+
+def write_baseline(path: str = BASELINE_PATH) -> dict:
+    """Measure and record the gate baseline into BASELINE.json['gate']."""
+    import datetime
+    summary = measure_smoke()
+    summary["recorded"] = datetime.date.today().isoformat()
+    with open(path) as f:
+        doc = json.load(f)
+    doc["gate"] = summary
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return summary
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--smoke", action="store_true",
+                      help="measure + print deltas vs baseline; ALWAYS exit "
+                           "0 (the tier-1 budget guard's per-verify report)")
+    mode.add_argument("--gate", action="store_true",
+                      help=f"measure + compare; exit {EXIT_REGRESSION} when "
+                           f"a gated sim metric regresses past threshold")
+    mode.add_argument("--write-baseline", action="store_true",
+                      help="measure and record the result as the new "
+                           "BASELINE.json gate block")
+    p.add_argument("--baseline", default=BASELINE_PATH,
+                   help="baseline JSON path (default: repo BASELINE.json)")
+    p.add_argument("--current", default=None, metavar="PATH",
+                   help="compare a saved measure_smoke() summary instead of "
+                        "measuring (offline gating of an artifact)")
+    args = p.parse_args(argv)
+    if args.write_baseline:
+        summary = write_baseline(args.baseline)
+        print(json.dumps(summary["sim"], sort_keys=True))
+        print(f"perfgate: baseline written to {args.baseline}")
+        return 0
+    current = None
+    if args.current:
+        with open(args.current) as f:
+            current = json.load(f)
+    return run(gate=args.gate, baseline_path=args.baseline, current=current)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
