@@ -34,7 +34,8 @@ and falls back to host only when jax itself is unavailable.
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -112,8 +113,10 @@ class DeviceConsultService:
         # counter track; ts is sim-micros when the store has a clock, else a
         # dispatch ordinal.  Appending is deterministic and touches no RNG /
         # scheduling, so the zero-observer-effect contract holds.
-        self.samples: List[Tuple[int, int, int]] = []
         self._sample_cap = 4096
+        self.samples: Deque[Tuple[int, int, int]] = \
+            deque(maxlen=self._sample_cap)
+        self.samples_dropped = 0
         # wall-clock profiler (observe.WallProfiler) — resolved lazily from
         # the owning node at first dispatch; False = probed, none attached
         self._profiler = None
@@ -334,11 +337,14 @@ class DeviceConsultService:
 
     # -- telemetry ------------------------------------------------------------
     def _sample(self, queue_depth: int, batch_rows: int) -> None:
-        if len(self.samples) >= self._sample_cap:
-            return
+        # ring semantics: a long soak keeps the RECENT trajectory (the
+        # windowed timeline and the Perfetto track both want the tail into a
+        # stall, not the warm-up) — drop the OLDEST sample past the cap
         ts = self._now()
         if ts is None:
-            ts = len(self.samples)
+            ts = self.samples_dropped + len(self.samples)
+        if len(self.samples) >= self._sample_cap:
+            self.samples_dropped += 1    # deque(maxlen) evicts the oldest
         self.samples.append((ts, queue_depth, batch_rows))
 
     def stats(self) -> Dict[str, object]:
@@ -362,4 +368,5 @@ class DeviceConsultService:
             "index_full_uploads": self.index.full_uploads,
             "index_incremental_refreshes": self.index.incremental_refreshes,
             "index_rows_uploaded": self.index.rows_uploaded,
+            "samples_dropped": self.samples_dropped,
         }

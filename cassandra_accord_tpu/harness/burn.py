@@ -940,6 +940,25 @@ def main(argv=None) -> None:
                    help="write the flight recorder's Chrome trace-event "
                         "JSON (open in Perfetto / chrome://tracing; one "
                         "track per node/store) after every seed")
+    p.add_argument("--timeline-out", default=None, metavar="PATH",
+                   help="write the sim-time windowed-telemetry JSONL "
+                        "(observe/timeline.py: per-window commits/s + "
+                        "latency p50/p95/p99 + in-flight + message rates, "
+                        "plus consult-service trajectory windows) after "
+                        "every seed; also adds a per-window counter track "
+                        "to --trace-out")
+    p.add_argument("--timeline-window", type=float, default=1.0,
+                   metavar="SIM_S",
+                   help="timeline window width in sim-seconds (default 1.0)")
+    p.add_argument("--burnrate", action="store_true",
+                   help="multi-window SLO burn-rate monitors "
+                        "(observe/burnrate.py) over commit latency and the "
+                        "auditor's liveness-flag plane: deterministic "
+                        "slo.burn events land in the --json audit verdict "
+                        "and the watchdog stall dump — mid-run early "
+                        "warning for soak burns (implies --audit=warn when "
+                        "auditing is off: the liveness-flag plane feeds "
+                        "the monitors)")
     p.add_argument("--profile", action="store_true",
                    help="two-plane performance profile per seed: the "
                         "sim-time critical-path latency budget (which "
@@ -997,13 +1016,24 @@ def main(argv=None) -> None:
         stem, ext = _p.splitext(path)
         return f"{stem}.seed{seed}{ext or '.json'}"
 
-    if args.reconcile and (args.metrics_out or args.trace_out or args.profile):
+    if args.reconcile and (args.metrics_out or args.trace_out or args.profile
+                           or args.timeline_out or args.burnrate):
         # reconcile runs two bare runs per seed and diffs them; a flight
         # recorder would conflate both into one recording — say so up front
         # instead of silently never writing the files
-        print("warning: --metrics-out/--trace-out/--profile are ignored with "
-              "--reconcile (no artifacts/profiles will be produced)",
+        print("warning: --metrics-out/--trace-out/--profile/--timeline-out/"
+              "--burnrate are ignored with --reconcile (no artifacts/"
+              "profiles will be produced)", flush=True)
+
+    if args.burnrate and args.audit == "off" and not args.reconcile:
+        # the monitors' liveness plane burns on the auditor's SLO-flag
+        # openings, and the --json burnrate report rides the audit verdict —
+        # without the auditor a total wedge starves BOTH monitor streams and
+        # nothing ever fires.  --burnrate therefore implies the warn plane.
+        print("note: --burnrate implies --audit=warn (the liveness-flag "
+              "plane feeds the monitors and carries their report)",
               flush=True)
+        args.audit = "warn"
 
     def write_json() -> None:
         if args.json is None:
@@ -1049,6 +1079,17 @@ def main(argv=None) -> None:
                   node_config=cfg,
                   max_tasks=200_000_000)
         observer = None
+        # per-seed trajectory planes: windowed sim-time telemetry
+        # (--timeline-out) and the multi-window SLO burn-rate monitors
+        # (--burnrate) — both ride whichever recorder/auditor is built below
+        timeline = None
+        if args.timeline_out and not args.reconcile:
+            from ..observe import Timeline
+            timeline = Timeline(window_us=int(args.timeline_window * 1e6))
+        monitor = None
+        if args.burnrate and not args.reconcile:
+            from ..observe import BurnRateMonitor
+            monitor = BurnRateMonitor()
         if args.audit != "off" and not args.reconcile:
             # the auditor IS a FlightRecorder, so it also serves
             # --metrics-out/--trace-out (reconcile runs construct their own
@@ -1057,13 +1098,15 @@ def main(argv=None) -> None:
             from ..observe import InvariantAuditor
             observer = InvariantAuditor(
                 mode=args.audit, slo_unattended_s=args.audit_slo,
-                record_messages=bool(args.trace_out or args.profile))
+                record_messages=bool(args.trace_out or args.profile),
+                timeline=timeline, burnrate=monitor)
             kw["observer"] = observer
             kw["audit"] = args.audit
         elif args.audit != "off" and args.reconcile:
             kw["audit"] = args.audit
             kw["audit_slo_s"] = args.audit_slo
-        elif (args.metrics_out or args.trace_out or args.profile) \
+        elif (args.metrics_out or args.trace_out or args.profile
+              or args.timeline_out or args.burnrate) \
                 and not args.reconcile:
             # flight recorder (reconcile runs its own two bare runs: the
             # recorder would conflate them, so it stays off there — warned
@@ -1072,7 +1115,8 @@ def main(argv=None) -> None:
             # split network wait from replica queueing
             from ..observe import FlightRecorder
             observer = FlightRecorder(
-                record_messages=bool(args.trace_out or args.profile))
+                record_messages=bool(args.trace_out or args.profile),
+                timeline=timeline, burnrate=monitor)
             kw["observer"] = observer
         profiler = None
         if args.profile and not args.reconcile:
@@ -1097,6 +1141,10 @@ def main(argv=None) -> None:
                 # along whenever the profiler ran
                 observer.write_trace(artifact_path(args.trace_out, seed),
                                      profiler=profiler)
+            if args.timeline_out and getattr(observer, "timeline", None) \
+                    is not None:
+                observer.write_timeline(
+                    artifact_path(args.timeline_out, seed))
 
         def profile_reports(entry, observer=observer, profiler=profiler,
                             seed=seed):

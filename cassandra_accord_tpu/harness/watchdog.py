@@ -17,6 +17,8 @@ from .cluster import Cluster
 
 _MAX_BLOCKED_PER_STORE = 24   # dump bound; the stall root is always among the
                               # oldest blocked ids, listed first
+_TIMELINE_DUMP_WINDOWS = 12   # last-N telemetry windows embedded in a stall
+                              # dump: the trajectory INTO the stall
 
 
 class StallError(Exception):
@@ -102,6 +104,30 @@ def dump_wait_state(cluster: Cluster) -> str:
                 lines.append("audit: " + report())
             except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
                 lines.append(f"audit: <error {e!r}>")
+        # burn-rate section (observe/burnrate.py): a monitor that fired
+        # mid-run DATED the degradation — its sim timestamps bound when the
+        # wedge began, long before this dump's final state
+        monitor = getattr(observer, "burnrate", None)
+        if monitor is not None and monitor.events:
+            import json as _json
+            try:
+                lines.append("slo_burn: " + _json.dumps(
+                    monitor.events[-8:], sort_keys=True, default=str))
+            except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
+                lines.append(f"slo_burn: <error {e!r}>")
+        # timeline section (observe/timeline.py): the last-N telemetry
+        # windows — windowed commits/s, latency percentiles, in-flight —
+        # i.e. the TRAJECTORY into the stall, not just the end snapshot
+        timeline = getattr(observer, "timeline", None)
+        if timeline is not None:
+            import json as _json
+            try:
+                recs = timeline.records(include_open=True)
+                lines.append("timeline: " + _json.dumps(
+                    recs[-_TIMELINE_DUMP_WINDOWS:], sort_keys=True,
+                    default=str))
+            except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
+                lines.append(f"timeline: <error {e!r}>")
     return "\n".join(lines)
 
 

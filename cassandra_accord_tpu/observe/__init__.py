@@ -22,6 +22,9 @@ from .export import chrome_trace, validate_chrome_trace, write_chrome_trace
 from .critical_path import (SEGMENT_CLASSES, extract_critical_paths,
                             format_budget, latency_budget)
 from .profiler import WallProfiler, format_wall_profile
+from .timeline import (Timeline, commits_per_sec_series, exact_percentile,
+                       write_timeline_jsonl)
+from .burnrate import BurnRateMonitor, SloSpec
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -31,4 +34,7 @@ __all__ = [
     "SEGMENT_CLASSES", "extract_critical_paths", "format_budget",
     "latency_budget",
     "WallProfiler", "format_wall_profile",
+    "Timeline", "commits_per_sec_series", "exact_percentile",
+    "write_timeline_jsonl",
+    "BurnRateMonitor", "SloSpec",
 ]

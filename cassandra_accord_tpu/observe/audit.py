@@ -135,10 +135,12 @@ class InvariantAuditor(FlightRecorder):
                  slo_undecided_s: Optional[float] = None,
                  slo_unapplied_s: Optional[float] = None,
                  message_ring: Optional[int] = None,
-                 record_messages: bool = False):
+                 record_messages: bool = False,
+                 timeline=None, burnrate=None):
         assert mode in ("strict", "warn"), f"bad audit mode {mode!r}"
         super().__init__(message_ring=message_ring,
-                         record_messages=record_messages)
+                         record_messages=record_messages,
+                         timeline=timeline, burnrate=burnrate)
         self.mode = mode
         # single source for the SLO ladder: call sites pass the user value
         # through (None = default), and the decision/apply budgets default to
@@ -268,6 +270,14 @@ class InvariantAuditor(FlightRecorder):
     def on_message_event(self, event: str, frm: int, to: int, msg_id,
                          message, now_us: int) -> None:
         super().on_message_event(event, frm, to, msg_id, message, now_us)
+        self._slo_check(now_us)
+
+    def on_reply_timeout(self, node: int, peer: int, txn_id,
+                         now_us: int) -> None:
+        super().on_reply_timeout(node, peer, txn_id, now_us)
+        # a total wedge (all journals stalled: held sends, no message
+        # events) still fires reply timeouts — without this pulse the SLO
+        # scan would sleep through exactly the stalls it exists to flag
         self._slo_check(now_us)
 
     def on_transition(self, node: int, store: int, txn_id,
@@ -673,12 +683,18 @@ class InvariantAuditor(FlightRecorder):
         self._slo_flags[key] = flag
         self._slo_history.append(flag)
         self.registry.counter(f"audit.{kind}").inc()
+        if self.burnrate is not None:
+            # a flag opening is one bad event on the liveness-SLO burn-rate
+            # monitors (observe/burnrate.py) — the early-warning plane
+            self.burnrate.on_flag_opened(kind, now_us)
 
     def _close_flag(self, kind: str, txn_id, now_us: int, why: str) -> None:
         flag = self._slo_flags.pop((kind, txn_id), None)
         if flag is not None:
             flag["closed_us"] = now_us
             flag["closed_because"] = why
+            if self.burnrate is not None:
+                self.burnrate.on_flag_closed(kind, now_us)
 
     # -- reporting ------------------------------------------------------------
     def open_slo_flags(self) -> List[dict]:
@@ -689,7 +705,7 @@ class InvariantAuditor(FlightRecorder):
 
     def verdict(self) -> dict:
         """Per-run audit summary (the burn CLI's --json per-seed verdict)."""
-        return {
+        out = {
             "mode": self.mode,
             "events_audited": self.events_audited,
             "violations": len(self.violations),
@@ -700,6 +716,12 @@ class InvariantAuditor(FlightRecorder):
             "slo_flags_open": len(self._slo_flags),
             "open_slo_flags": self.open_slo_flags()[:16],
         }
+        if self.burnrate is not None:
+            # the burn-rate monitors' slo.burn events land in the SAME warn
+            # stream as the flags: a soak's --json verdict carries the
+            # early-warning trajectory, not just the end-state flags
+            out.update(self.burnrate.report())
+        return out
 
     def audit_report(self) -> str:
         """One-paragraph text report for the watchdog's stall dump."""

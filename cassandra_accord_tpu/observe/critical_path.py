@@ -66,13 +66,15 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Tuple
 
+from . import schema
+
 SEGMENT_CLASSES = ("message_wait", "replica_queue_wait", "handler_compute",
                    "device_consult_wait", "fence_bootstrap_wait", "deps_wait",
                    "recovery", "unattributed")
 
 # span outcomes that count as a COMMIT for the latency budget (invalidated /
 # lost / failed ops have no commit latency to attribute)
-_COMMIT_OUTCOMES = ("fast", "slow", "recovered")
+_COMMIT_OUTCOMES = schema.COMMIT_OUTCOMES
 
 # SaveStatus names marking "the decision is known at this store"
 _DECIDED = ("PRE_COMMITTED", "COMMITTED", "STABLE", "READY_TO_EXECUTE",

@@ -118,6 +118,41 @@ def service_counter_events(recorder,
     return events
 
 
+def timeline_counter_events(recorder) -> List[dict]:
+    """Per-window telemetry counter track (pid 0, tid 2) from an attached
+    ``observe/timeline.Timeline``: windowed commits/s, p99 commit latency
+    (ms) and in-flight txns — the trajectory curves, natively in Perfetto,
+    one ``C`` event per sim-time window.  Empty when no timeline rode the
+    recorder."""
+    timeline = getattr(recorder, "timeline", None)
+    if timeline is None:
+        return []
+    from . import schema
+    from .timeline import COMMIT_OUTCOMES
+    commit_names = [schema.OUTCOME_METRICS[o] for o in COMMIT_OUTCOMES]
+    events: List[dict] = []
+    for rec in timeline.records(include_open=True):
+        cluster = rec["scopes"].get("cluster", {})
+        args: dict = {}
+        rates = cluster.get("rates_per_s", {})
+        # ALWAYS emitted, 0.0 included: Perfetto holds a counter at its last
+        # sample until the next one, so skipping commit-less windows would
+        # render a stall as a flat healthy line — the exact trajectory this
+        # track exists to show is commits/s falling to zero
+        args["commits_per_sec"] = round(
+            sum(rates.get(n, 0.0) for n in commit_names), 3)
+        pct = cluster.get("percentiles", {}).get(schema.LATENCY_METRIC)
+        if pct and pct.get("p99") is not None:
+            args["latency_p99_ms"] = round(pct["p99"] / 1000.0, 3)
+        sample = cluster.get("samples", {}).get(schema.TIMELINE_IN_FLIGHT_METRIC)
+        if sample is not None:
+            args["in_flight"] = sample
+        events.append({"name": "timeline", "cat": "counter", "ph": "C",
+                       "ts": rec["start_us"], "pid": COUNTER_PID, "tid": 2,
+                       "args": args})
+    return events
+
+
 def wall_profile_events(recorder, profiler) -> List[dict]:
     """Plane-2 tracks: one ``X`` slice per recorded handler invocation on
     the synthetic wall-clock process (pid ``WALL_PID``, tid = node id,
@@ -219,6 +254,11 @@ def chrome_trace(recorder, include_messages: bool = True,
         pids.add(COUNTER_PID)
         tids.add((COUNTER_PID, 1))
         events.extend(svc_counters)
+    tl_counters = timeline_counter_events(recorder)
+    if tl_counters:
+        pids.add(COUNTER_PID)
+        tids.add((COUNTER_PID, 2))
+        events.extend(tl_counters)
     if include_messages:
         for seq, ts, event, frm, to, msg_id, brief in recorder.messages:
             pids.add(frm)
@@ -240,7 +280,8 @@ def chrome_trace(recorder, include_messages: bool = True,
                      "tid": 0, "args": {"name": pname}})
     for pid, tid in sorted(tids):
         if pid == COUNTER_PID:
-            name = "counters" if tid == 0 else "consult service"
+            name = {0: "counters", 1: "consult service",
+                    2: "timeline"}.get(tid, f"counters {tid}")
         elif pid == WALL_PID:
             name = f"node {tid} handlers (wall)"
         else:

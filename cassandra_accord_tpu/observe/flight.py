@@ -38,10 +38,23 @@ class FlightRecorder:
     """Metrics + spans + message events for one deterministic run."""
 
     def __init__(self, message_ring: Optional[int] = None,
-                 record_messages: bool = True):
+                 record_messages: bool = True,
+                 timeline=None, burnrate=None):
         self.registry = MetricsRegistry()
         self.spans = TxnSpanRecorder()
         self.record_messages = record_messages
+        # sim-time windowed telemetry (observe/timeline.py): counters become
+        # per-window rates, gauges samples, latencies per-window percentiles.
+        # Same zero-observer-effect contract as every other plane here.
+        self.timeline = timeline
+        # multi-window SLO burn-rate monitors (observe/burnrate.py): mid-run
+        # early warning fed from the same hooks
+        self.burnrate = burnrate
+        if burnrate is not None:
+            burnrate.bind(self)
+        # in-flight client ops (submit minus resolve), sampled onto the
+        # timeline — the commits/s-vs-in-flight curve ROADMAP item 2 reads
+        self._in_flight = 0
         # the message timeline IS a Trace (same event tuples, same optional
         # ring bound) — one ring-buffer implementation, not two
         self._message_trace = Trace(keep_last=message_ring)
@@ -66,26 +79,45 @@ class FlightRecorder:
     def on_message_event(self, event: str, frm: int, to: int, msg_id,
                          message, now_us: int) -> None:
         reg = self.registry
+        tl = self.timeline
         if event in _SEND_EVENTS:
             name = _message_metric(message)
             reg.counter(name).inc()
             reg.counter(name, node=frm).inc()
             reg.counter(f"link.{event.lower()}").inc()
+            if tl is not None:
+                tl.count(name, now_us)
+                tl.count(f"link.{event.lower()}", now_us)
         elif event.startswith("RPLY_"):
             name = _message_metric(message)
             reg.counter(name).inc()
             reg.counter(name, node=frm).inc()
             reg.counter(f"link.reply_{event[5:].lower()}").inc()
+            if tl is not None:
+                tl.count(name, now_us)
+                tl.count(f"link.reply_{event[5:].lower()}", now_us)
         else:   # RECV / RECV_RPLY: the delivery, counted at the receiver
             reg.counter("msg.received", node=to).inc()
+            if tl is not None:
+                tl.count("msg.received", now_us, node=to)
         if self.record_messages:
             self._message_trace.hook(event, frm, to, msg_id, message, now_us)
+        if self.burnrate is not None:
+            # clock pulse: a total wedge produces no resolutions, but probes
+            # and timeouts keep the message plane (and so the monitors) live
+            self.burnrate.on_pulse(now_us)
 
     def on_reply_timeout(self, node: int, peer: int, txn_id,
                          now_us: int) -> None:
         self.registry.counter("net.reply_timeouts").inc()
         self.registry.counter("net.reply_timeouts", node=node).inc()
         self.spans.on_timeout(txn_id)
+        if self.timeline is not None:
+            self.timeline.count("net.reply_timeouts", now_us)
+        if self.burnrate is not None:
+            # timeouts keep firing through a total wedge (held sends emit no
+            # message events) — they are the monitor's clock there
+            self.burnrate.on_pulse(now_us)
 
     def on_backoff(self, node: int, txn_id, attempt: int) -> None:
         self.registry.counter("net.backoff_rearms").inc()
@@ -98,14 +130,31 @@ class FlightRecorder:
         self.spans.on_submit(op_id, txn_id, coordinator, now_us)
         self.registry.counter(schema.SUBMITTED_METRIC).inc()
         self.registry.counter(schema.SUBMITTED_METRIC, node=coordinator).inc()
+        self._in_flight += 1
+        if self.timeline is not None:
+            self.timeline.count(schema.SUBMITTED_METRIC, now_us)
+            self.timeline.count(schema.SUBMITTED_METRIC, now_us,
+                                node=coordinator)
+            self.timeline.sample(schema.TIMELINE_IN_FLIGHT_METRIC,
+                                 self._in_flight, now_us)
 
     def on_resolve(self, txn_id, kind: str, now_us: int) -> None:
         outcome = self.spans.on_resolve(txn_id, kind, now_us)
         self.registry.counter(schema.OUTCOME_METRICS[outcome]).inc()
         span = self.spans.spans[txn_id]
+        latency_us = None
         if span.submitted_us is not None:
-            self.registry.histogram(schema.LATENCY_METRIC) \
-                .record(now_us - span.submitted_us)
+            latency_us = now_us - span.submitted_us
+            self.registry.histogram(schema.LATENCY_METRIC).record(latency_us)
+        self._in_flight -= 1
+        if self.timeline is not None:
+            self.timeline.count(schema.OUTCOME_METRICS[outcome], now_us)
+            self.timeline.sample(schema.TIMELINE_IN_FLIGHT_METRIC,
+                                 self._in_flight, now_us)
+            if latency_us is not None:
+                self.timeline.value(schema.LATENCY_METRIC, latency_us, now_us)
+        if self.burnrate is not None:
+            self.burnrate.on_resolution(outcome, latency_us, now_us)
 
     # -- coordination classification (coordinate/) ---------------------------
     def on_path(self, txn_id, path: str,
@@ -125,6 +174,8 @@ class FlightRecorder:
             # sim-timestamped attribution: the Chrome-trace export's
             # recovery counter track samples these
             self._recovery_times.append(now_us)
+            if self.timeline is not None:
+                self.timeline.count("recovery.attempts", now_us)
 
     def on_invalidate(self, node: int, txn_id, now_us=None) -> None:
         self.spans.on_invalidate_attempt(txn_id)
@@ -132,6 +183,8 @@ class FlightRecorder:
         self.registry.counter("recovery.invalidate_attempts", node=node).inc()
         if now_us is not None:
             self._invalidate_times.append(now_us)
+            if self.timeline is not None:
+                self.timeline.count("recovery.invalidate_attempts", now_us)
 
     # -- replica-side lifecycle (local/commands.py) --------------------------
     def on_transition(self, node: int, store: int, txn_id,
@@ -145,6 +198,9 @@ class FlightRecorder:
         name = schema.metric_for_save_status(status_name)
         self.registry.counter(name).inc()
         self.registry.counter(name, node=node, store=store).inc()
+        if self.timeline is not None:
+            self.timeline.count(name, now_us)
+            self.timeline.count(name, now_us, node=node, store=store)
 
     # -- node lifecycle (harness/cluster.py crash/restart) -------------------
     def on_crash(self, node_id: int) -> None:
@@ -199,6 +255,12 @@ class FlightRecorder:
         if cluster is not None:
             self.collect_cluster(cluster)
         return self.registry.to_json()
+
+    def write_timeline(self, path: str) -> None:
+        """Write the windowed-telemetry JSONL artifact (burn CLI
+        ``--timeline-out``); requires a timeline attached at construction."""
+        from .timeline import write_timeline_jsonl
+        write_timeline_jsonl(path, self)
 
     def chrome_trace(self, profiler=None) -> dict:
         from .export import chrome_trace

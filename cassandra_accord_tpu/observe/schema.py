@@ -105,6 +105,9 @@ SAVE_STATUS_METRICS = {
 
 OUTCOMES = ("fast", "slow", "recovered", "invalidated", "lost", "failed")
 OUTCOME_METRICS = {o: f"txn.resolved.{o}" for o in OUTCOMES}
+# the outcome classes that ARE commits — the critical-path extractor's
+# admission set and the windowed commits/s curve sum over the same tuple
+COMMIT_OUTCOMES = ("fast", "slow", "recovered")
 
 SUBMITTED_METRIC = "txn.submitted"
 LATENCY_METRIC = "txn.latency_us"
@@ -136,6 +139,7 @@ SERVICE_STAT_METRICS = {
     "index_full_uploads": "service.index_full_uploads",
     "index_incremental_refreshes": "service.index_incremental_refreshes",
     "index_rows_uploaded": "service.index_rows_uploaded",
+    "samples_dropped": "service.samples_dropped",
 }
 SERVICE_BATCH_SIZE_METRIC = "service.batch_size"
 
@@ -149,6 +153,79 @@ STORE_GAUGE_METRICS = {
     "cache_miss_loads": "store.cache_miss_loads",
     "tfk_inversions": "store.tfk_inversions",
 }
+
+# -- timeline-only series (observe/timeline.py; never in the registry) -------
+# the windowed in-flight gauge is maintained by the flight recorder's own
+# submit/resolve envelope (submitted - resolved), sampled into the timeline
+
+TIMELINE_IN_FLIGHT_METRIC = "txn.in_flight"
+
+# -- timeline policy declarations ---------------------------------------------
+# Every metric the schema registers declares how the sim-time timeline
+# (observe/timeline.py) treats it — its TIMELINE POLICY:
+#
+#   ``rate``       event-stream counter: per-window increment count + rate/s
+#   ``sample``     gauge: last value observed inside each window
+#   ``percentile`` value stream: per-window exact p50/p95/p99 (nearest-rank)
+#   ``excluded``   no per-event stream exists (end-of-run pull-collected
+#                  gauges) or the series is deliberately not windowed
+#
+# Two-way linted (tests/test_observe.py) against the metric tables above,
+# exactly like METRIC_UNITS: a new schema metric without a policy fails
+# tier-1, and so does a stale policy entry for a removed metric.  The
+# Timeline enforces the declaration at feed time — feeding an ``excluded``
+# metric, or feeding with the wrong verb, raises.
+
+TIMELINE_POLICY_VALUES = ("rate", "sample", "percentile", "excluded")
+
+TIMELINE_POLICIES = {
+    SUBMITTED_METRIC: "rate",
+    LATENCY_METRIC: "percentile",
+    TIMELINE_IN_FLIGHT_METRIC: "sample",
+    SERVICE_BATCH_SIZE_METRIC: "percentile",
+    **{name: "rate" for name in OUTCOME_METRICS.values()},
+    # pull-collected end-of-run gauges: there is no per-event stream to
+    # window (the consult-service QUEUE trajectory is windowed separately
+    # from its deterministic (ts, depth, rows) samples — timeline.py
+    # service_window_records)
+    **{name: "excluded" for name in RESOLVER_METRICS.values()},
+    **{name: "excluded" for name in SERVICE_STAT_METRICS.values()},
+    **{name: "excluded" for name in STORE_GAUGE_METRICS.values()},
+}
+
+# dynamic metric families resolve by prefix (same pattern as
+# METRIC_UNIT_PREFIXES); explicit entries take precedence
+TIMELINE_POLICY_PREFIXES = {
+    "msg.": "rate",              # MESSAGE_METRICS + msg.received/unregistered
+    "link.": "rate",
+    "net.": "rate",
+    "txn.status.": "rate",
+    "txn.save_status.": "rate",
+    "txn.path.": "rate",
+    "txn.fastpath.": "rate",
+    "recovery.": "rate",
+    "progress.": "rate",
+    "lifecycle.": "rate",
+    "slo.": "rate",              # burn-rate monitor firings (observe/burnrate)
+    "audit.": "excluded",        # violation counters: forensic, not windowed
+    "sim.": "excluded",          # pull-collected cluster.stats mirror
+}
+
+
+def timeline_policy_for(metric_name: str) -> str:
+    """Declared timeline policy for a metric; KeyError (with the fix) for an
+    undeclared one — the lint test turns that into a tier-1 failure."""
+    policy = TIMELINE_POLICIES.get(metric_name)
+    if policy is not None:
+        return policy
+    for prefix, policy in TIMELINE_POLICY_PREFIXES.items():
+        if metric_name.startswith(prefix):
+            return policy
+    raise KeyError(
+        f"metric {metric_name!r} declares no timeline policy: add it to "
+        f"observe/schema.py TIMELINE_POLICIES "
+        f"(rate | sample | percentile | excluded)")
+
 
 # -- unit / time-plane declarations -------------------------------------------
 # Every HISTOGRAM and GAUGE metric declares its unit, which doubles as its

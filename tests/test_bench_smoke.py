@@ -17,8 +17,14 @@ BENCH = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.fixture(scope="module")
-def smoke_run():
-    env = dict(os.environ, JAX_PLATFORMS="cpu", ACCORD_BENCH_DEADLINE_S="150")
+def smoke_ledger(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("trend") / "hist.jsonl")
+
+
+@pytest.fixture(scope="module")
+def smoke_run(smoke_ledger):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ACCORD_BENCH_DEADLINE_S="150",
+               ACCORD_BENCH_HISTORY=smoke_ledger)
     proc = subprocess.run([sys.executable, BENCH, "--smoke"],
                           capture_output=True, text=True, timeout=200,
                           env=env, cwd=os.path.dirname(BENCH))
@@ -50,3 +56,29 @@ def test_smoke_emits_full_detail_object_before_tail(smoke_run):
     assert smoke["sim"]["commits"] == smoke["workload"]["ops"]
     assert smoke["attributed_share"] >= 0.95
     assert smoke["dominating_class"]
+
+
+def test_smoke_appends_one_trend_ledger_record(smoke_run, smoke_ledger):
+    """Every bench run appends its summary to the trend ledger
+    (BENCH_HISTORY.jsonl via ACCORD_BENCH_HISTORY) — the durable perf
+    trajectory tools/trend.py renders."""
+    records = [json.loads(l)
+               for l in open(smoke_ledger).read().splitlines() if l.strip()]
+    assert len(records) == 1
+    assert records[0]["kind"] == "bench"
+    assert records[0]["sim"]["commit_latency_mean_us"] > 0
+
+
+def test_inject_self_test_bench_run_skips_the_ledger(tmp_path):
+    """ACCORD_PERFGATE_INJECT_LATENCY doctors the measured latencies — a
+    bench run under it must NOT append to the trend ledger (where it would
+    read as a real 2x regression); the gate must still trip (exit 3)."""
+    ledger = tmp_path / "hist.jsonl"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ACCORD_BENCH_DEADLINE_S="150",
+               ACCORD_BENCH_HISTORY=str(ledger),
+               ACCORD_PERFGATE_INJECT_LATENCY="2.0")
+    proc = subprocess.run([sys.executable, BENCH, "--gate"],
+                          capture_output=True, text=True, timeout=200,
+                          env=env, cwd=os.path.dirname(BENCH))
+    assert proc.returncode == 3, (proc.stdout[-800:], proc.stderr[-800:])
+    assert not ledger.exists(), "doctored run leaked into the trend ledger"

@@ -1,0 +1,213 @@
+"""Multi-window SLO burn-rate monitors (observe/burnrate.py).
+
+The acceptance shape (ISSUE 10): on an injected journal-stall wedge the
+``slo.burn`` monitor flags the degradation strictly earlier (sim time) than
+the watchdog's stall exit, and it stays silent across the clean matrix.
+Plus the monitor math itself: two-window confirmation (a short burst alone
+cannot fire), minimum bad-event count, episode clear.
+"""
+import re
+
+import pytest
+
+from cassandra_accord_tpu.harness import burn as burn_mod
+from cassandra_accord_tpu.harness.burn import SimulationException, run_burn
+from cassandra_accord_tpu.harness.watchdog import StallError
+from cassandra_accord_tpu.observe import (BurnRateMonitor, FlightRecorder,
+                                          InvariantAuditor, SloSpec, Timeline)
+
+
+# ---------------------------------------------------------------------------
+# monitor math (synthetic event streams, no burn)
+# ---------------------------------------------------------------------------
+
+def _latency_spec(**kw):
+    defaults = dict(budget=0.1, short_s=1.0, long_s=10.0, burn_threshold=5.0,
+                    min_bad=2, latency_slo_us=100)
+    defaults.update(kw)
+    return SloSpec("t", "latency", **defaults)
+
+
+def test_short_burst_alone_does_not_fire():
+    """The two-window guard: a healthy long window vetoes a short bad
+    burst (the standard multi-window burn-rate construction)."""
+    m = BurnRateMonitor(specs=(_latency_spec(),))
+    for i in range(100):                      # 10 good/s for 10 sim-seconds
+        m.on_resolution("fast", 50, now_us=i * 100_000)
+    for i in range(4):                        # short bad burst at t=10s
+        m.on_resolution("fast", 500, now_us=10_000_000 + i * 1_000)
+    assert m.events == [], "short burst fired without long-window confirmation"
+
+
+def test_sustained_burn_fires_and_clears():
+    m = BurnRateMonitor(specs=(_latency_spec(),))
+    for i in range(100):
+        m.on_resolution("fast", 50, now_us=i * 100_000)
+    t = 10_000_000
+    while t < 21_000_000:                     # sustained bad for 11 sim-s
+        m.on_resolution("fast", 500, now_us=t)
+        t += 200_000
+    assert len(m.events) == 1
+    ev = m.events[0]
+    assert ev["kind"] == "slo.burn" and ev["slo"] == "t"
+    assert ev["short_burn_rate"] >= 5.0 and ev["long_burn_rate"] >= 5.0
+    assert ev["cleared_us"] is None and m.open_burns()
+    while t < 45_000_000:                     # recovery: good events again
+        m.on_resolution("fast", 50, now_us=t)
+        t += 200_000
+    assert ev["cleared_us"] is not None and m.open_burns() == []
+    assert len(m.events) == 1, "recovery must clear, not re-fire"
+
+
+def test_min_bad_events_guard():
+    """Below min_bad the monitor cannot fire even at infinite burn rate
+    (one unlucky txn in an otherwise-quiet window)."""
+    m = BurnRateMonitor(specs=(_latency_spec(min_bad=5),))
+    for i in range(3):
+        m.on_resolution("fast", 500, now_us=20_000_000 + i * 100_000)
+    assert m.events == []
+
+
+def test_failed_outcome_counts_bad_and_flags_drive_liveness():
+    lat = _latency_spec()
+    live = SloSpec("live", "liveness", budget=0.1, short_s=1.0, long_s=10.0,
+                   burn_threshold=5.0, min_bad=2)
+    m = BurnRateMonitor(specs=(lat, live))
+    for i in range(30):
+        m.on_flag_opened("slo.unattended", now_us=20_000_000 + i * 100_000)
+    fired = {e["slo"] for e in m.events}
+    assert "live" in fired and "t" not in fired
+    m2 = BurnRateMonitor(specs=(_latency_spec(),))
+    for i in range(30):                       # failed ops burn latency SLO
+        m2.on_resolution("failed", None, now_us=20_000_000 + i * 100_000)
+    assert {e["slo"] for e in m2.events} == {"t"}
+
+
+# ---------------------------------------------------------------------------
+# the clean matrix stays silent
+# ---------------------------------------------------------------------------
+
+def test_silent_on_clean_matrix():
+    """A benign burn (no faults) with monitors + auditor attached: zero
+    slo.burn events, zero registry burn counters."""
+    monitor = BurnRateMonitor()
+    auditor = InvariantAuditor(mode="strict", burnrate=monitor)
+    run_burn(4, ops=120, concurrency=12, journal=True, durability=True,
+             observer=auditor, audit="strict")
+    assert monitor.events == []
+    assert monitor.report()["slo_burn_events"] == 0
+    snap = auditor.metrics_snapshot().get("cluster", {})
+    assert not any(k.startswith("slo.burn") for k in snap)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance shape: early warning on an injected journal-stall wedge
+# ---------------------------------------------------------------------------
+
+def test_burn_monitor_fires_before_watchdog_on_injected_stall():
+    """Inject a total journal-stall wedge mid-burn (every node's append path
+    stalls; fsync-before-reply holds all outbound packets).  The watchdog
+    exits at wedge + 30 sim-seconds; the slo.burn monitor must flag the
+    wedge STRICTLY earlier, and the stall dump must embed the burn events
+    and the last-N timeline windows (the trajectory into the stall)."""
+    monitor = BurnRateMonitor()
+    auditor = InvariantAuditor(mode="warn", slo_unattended_s=2.0,
+                               burnrate=monitor, timeline=Timeline())
+    wedged = {"at_us": None}
+
+    def wedge(op_id, txn_id, txn, coordinator):
+        if op_id == 30 and wedged["at_us"] is None:
+            cluster = burn_mod.last_cluster()
+            wedged["at_us"] = cluster.now_micros
+            for n in sorted(cluster.nodes):
+                cluster.stall_journal(n)
+
+    with pytest.raises(SimulationException) as ei:
+        run_burn(2, ops=400, concurrency=10, journal=True, durability=True,
+                 observer=auditor, audit="warn", on_submit=wedge,
+                 stall_watchdog_s=60.0, max_tasks=20_000_000)
+    cause = ei.value.cause
+    assert isinstance(cause, StallError), f"expected a stall, got {cause!r}"
+    assert wedged["at_us"] is not None, "the wedge never injected"
+    # the monitor fired, and strictly earlier than the watchdog's exit
+    assert monitor.events, "no slo.burn event on a total wedge"
+    first_burn_us = monitor.events[0]["sim_us"]
+    m = re.search(r"sim_time_s=([0-9.]+)", cause.dump)
+    assert m, "stall dump lost its sim_time_s header"
+    stall_us = float(m.group(1)) * 1e6
+    assert wedged["at_us"] < first_burn_us < stall_us, \
+        f"monitor fired at {first_burn_us}us, watchdog at {stall_us}us " \
+        f"(wedge at {wedged['at_us']}us) — not an early warning"
+    # the warn-stream verdict carries the burn events (failure path too)
+    verdict = ei.value.audit
+    assert verdict is not None and verdict["slo_burn_events"] >= 1
+    assert verdict["first_slo_burn"]["sim_us"] == first_burn_us
+    # the stall dump embeds both trajectory sections
+    assert "slo_burn: " in cause.dump
+    assert "timeline: " in cause.dump
+
+
+def test_cli_burnrate_implies_audit_warn(tmp_path, capsys):
+    """``--burnrate`` with auditing off upgrades to ``--audit=warn``: the
+    liveness monitors burn on the auditor's flag plane and the report rides
+    the audit verdict — without the upgrade a total wedge would starve both
+    monitor streams and the flag would silently do nothing."""
+    out = tmp_path / "b.json"
+    burn_mod.main(["--seeds", "0", "--ops", "25", "--benign", "--no-churn",
+                   "--burnrate", "--json", str(out)])
+    assert "--burnrate implies --audit=warn" in capsys.readouterr().out
+    import json
+    entry = json.loads(out.read_text())["results"][0]
+    assert entry["status"] == "pass"
+    # the audit verdict exists (warn plane) and carries the monitor report
+    assert entry["audit"]["mode"] == "warn"
+    assert entry["audit"]["slo_burn_events"] == 0
+
+
+def test_perfetto_commits_track_drops_to_zero_through_a_wedge():
+    """The Perfetto counter track emits commits_per_sec=0.0 for windows
+    with message traffic but no commit outcomes — Perfetto holds a counter
+    at its last sample, so skipping those windows would render a stall as
+    a flat healthy line."""
+    from cassandra_accord_tpu.observe.export import timeline_counter_events
+    from cassandra_accord_tpu.observe import schema
+    tl = Timeline(window_us=1_000_000)
+    rec = FlightRecorder(timeline=tl)
+    # window 0: one commit; windows 1-2: probes/timeouts only (the wedge)
+    tl.count(schema.OUTCOME_METRICS["fast"], 100)
+    tl.count("net.reply_timeouts", 1_000_100)
+    tl.count("net.reply_timeouts", 2_000_100)
+    events = timeline_counter_events(rec)
+    cps = [e["args"]["commits_per_sec"] for e in events]
+    assert cps == [1.0, 0.0, 0.0]
+
+
+def test_stall_dump_timeline_shows_commits_drying_up():
+    """The embedded windows are the trajectory INTO the stall: early windows
+    carry resolutions, the tail windows carry none (that is what the
+    watchdog reader needs to see at a glance)."""
+    monitor = BurnRateMonitor()
+    timeline = Timeline()
+    rec = FlightRecorder(timeline=timeline, burnrate=monitor)
+    wedged = {"done": False}
+
+    def wedge(op_id, txn_id, txn, coordinator):
+        if op_id == 25 and not wedged["done"]:
+            wedged["done"] = True
+            cluster = burn_mod.last_cluster()
+            for n in sorted(cluster.nodes):
+                cluster.stall_journal(n)
+
+    with pytest.raises(SimulationException) as ei:
+        run_burn(2, ops=400, concurrency=10, journal=True, durability=True,
+                 observer=rec, on_submit=wedge,
+                 stall_watchdog_s=20.0, max_tasks=20_000_000)
+    assert isinstance(ei.value.cause, StallError)
+    from cassandra_accord_tpu.observe.timeline import commits_per_sec_series
+    series = commits_per_sec_series(timeline.records())
+    assert series, "no commits/s windows recorded"
+    windows = {w for w, _v in series}
+    last_window = max(r["window"] for r in timeline.records())
+    # the tail of the run (the stalled stretch) has NO commit windows
+    assert last_window - 5 > max(windows), \
+        "commit windows continue into the stall — wedge not visible"

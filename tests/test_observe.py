@@ -167,6 +167,43 @@ def test_every_gauge_and_histogram_declares_unit_two_way():
                       f"registry: {walls}"
 
 
+def test_every_schema_metric_declares_timeline_policy_two_way():
+    """Timeline-policy lint: every metric the schema registers resolves to a
+    declared timeline policy (rate | sample | percentile | excluded), there
+    are no stale explicit entries for removed metrics, and the policy
+    vocabulary is closed — two-way, like METRIC_UNITS."""
+    known = ({schema.SUBMITTED_METRIC, schema.LATENCY_METRIC,
+              schema.SERVICE_BATCH_SIZE_METRIC,
+              schema.TIMELINE_IN_FLIGHT_METRIC}
+             | set(schema.MESSAGE_METRICS.values())
+             | set(schema.STATUS_METRICS.values())
+             | set(schema.SAVE_STATUS_METRICS.values())
+             | set(schema.OUTCOME_METRICS.values())
+             | set(schema.RESOLVER_METRICS.values())
+             | set(schema.SERVICE_STAT_METRICS.values())
+             | set(schema.STORE_GAUGE_METRICS.values()))
+    for name in sorted(known):
+        schema.timeline_policy_for(name)   # KeyError = undeclared, tier-1
+    # no stale EXPLICIT entries (prefix families are covered by resolution)
+    stale = sorted(set(schema.TIMELINE_POLICIES) - known)
+    assert not stale, f"stale TIMELINE_POLICIES entries: {stale}"
+    bad = {k: v for k, v in schema.TIMELINE_POLICIES.items()
+           if v not in schema.TIMELINE_POLICY_VALUES}
+    bad.update({k: v for k, v in schema.TIMELINE_POLICY_PREFIXES.items()
+                if v not in schema.TIMELINE_POLICY_VALUES})
+    assert not bad, \
+        f"policies outside the {schema.TIMELINE_POLICY_VALUES} vocabulary: {bad}"
+    # undeclared metrics raise actionably (the live half of the lint —
+    # observe/timeline.Timeline enforces this on every feed)
+    with pytest.raises(KeyError, match="TIMELINE_POLICIES"):
+        schema.timeline_policy_for("bogus.metric")
+    # spot anchors: the headline series carry the intended policies
+    assert schema.timeline_policy_for(schema.LATENCY_METRIC) == "percentile"
+    assert schema.timeline_policy_for(schema.SUBMITTED_METRIC) == "rate"
+    assert schema.timeline_policy_for(schema.TIMELINE_IN_FLIGHT_METRIC) \
+        == "sample"
+
+
 def test_observed_burn_gauges_all_resolve_units():
     """Every gauge/histogram a real instrumented burn actually registers
     resolves through unit_for — dynamic sim.* mirrors included; an

@@ -112,3 +112,133 @@ def test_summary_is_stable_json(measured):
     doc = json.loads(json.dumps(measured, sort_keys=True))
     assert doc["sim"]["commits"] == perfgate.SMOKE_KW["ops"]
     assert doc["attributed_share"] >= 0.95
+
+
+# ---------------------------------------------------------------------------
+# multi-seed median gating (--seeds), per the KNOWN_ISSUES trajectory-
+# sensitivity note: single-seed regressions are knife-edge chaotic, so the
+# gate judges the MEDIAN per-seed current/baseline ratio
+# ---------------------------------------------------------------------------
+
+def _synth(seed, scale=1.0):
+    return {"workload": {"seed": seed},
+            "sim": {k: round(1000.0 * scale, 1)
+                    for k, _t in perfgate.GATED_METRICS}}
+
+
+def _synth_baseline(seeds):
+    return {"workload": {"seed": perfgate.SMOKE_SEED},
+            "sim": {k: 1000.0 for k, _t in perfgate.GATED_METRICS},
+            "recorded": "t",
+            "seeds": {str(s): {"sim": {k: 1000.0 for k, _t
+                                       in perfgate.GATED_METRICS}}
+                      for s in seeds}}
+
+
+def test_multi_seed_one_chaotic_seed_cannot_trip():
+    """One knife-edge seed regressing 3x does NOT trip the gate while the
+    median of three seeds stays flat — the whole point of --seeds."""
+    base = _synth_baseline([1, 2, 3])
+    per_seed = {1: _synth(1), 2: _synth(2), 3: _synth(3, scale=3.0)}
+    lines, failures = perfgate.compare_multi(per_seed, base)
+    assert failures == [], "\n".join(lines)
+    assert any("median 1.000x" in l for l in lines)
+
+
+def test_multi_seed_median_regression_trips():
+    """Two of three seeds regressed past threshold: the median trips, and
+    the failure names the metric + seed count."""
+    base = _synth_baseline([1, 2, 3])
+    per_seed = {1: _synth(1, 2.0), 2: _synth(2, 2.0), 3: _synth(3)}
+    _lines, failures = perfgate.compare_multi(per_seed, base)
+    assert failures and all("median 2.00x" in f for f in failures)
+    assert len(failures) == len(perfgate.GATED_METRICS)
+
+
+def test_multi_seed_missing_per_seed_baseline_is_not_comparable():
+    """A seed with no recorded baseline row is reported loudly as not
+    comparable (with the --write-baseline --seeds fix), never silently
+    passed; the default smoke seed falls back to the default sim block."""
+    base = _synth_baseline([])          # no per-seed table at all
+    per_seed = {perfgate.SMOKE_SEED: _synth(perfgate.SMOKE_SEED, 2.0),
+                99: _synth(99, 2.0)}
+    lines, failures = perfgate.compare_multi(per_seed, base)
+    # the default seed compares via the fallback; 99 is flagged uncomparable
+    assert failures, "default-seed fallback lost the regression"
+    assert any("s99:" in l and "?" in l for l in lines)
+    assert perfgate.baseline_sim_for(base, 99) is None
+    assert perfgate.baseline_sim_for(base, perfgate.SMOKE_SEED) == base["sim"]
+
+
+def test_multi_seed_run_measures_each_seed_and_appends_median(
+        tmp_path, monkeypatch):
+    """run(seeds=[...]) measures every listed seed, gates on the median,
+    and appends ONE ledger record carrying the per-metric median sim."""
+    ledger = tmp_path / "hist.jsonl"
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", str(ledger))
+    measured_seeds = []
+
+    def fake_smoke(seed):
+        measured_seeds.append(seed)
+        return _synth(seed, scale={1: 0.9, 2: 1.0, 3: 1.1}[seed])
+    monkeypatch.setattr(perfgate, "measure_smoke", fake_smoke)
+    out = io.StringIO()
+    rc = perfgate.run(gate=True, current=None, out=out, seeds=[1, 2, 3])
+    assert rc == 0 and measured_seeds == [1, 2, 3]
+    assert "gating on the MEDIAN" in out.getvalue()
+    entries = [json.loads(l) for l in ledger.read_text().splitlines()]
+    assert len(entries) == 1
+    assert entries[0]["kind"] == "perfgate" and entries[0]["seeds"] == [1, 2, 3]
+    for key, _t in perfgate.GATED_METRICS:
+        assert entries[0]["sim"][key] == 1000.0   # the median (scale 1.0)
+
+
+def test_single_listed_seed_is_measured_as_that_seed(tmp_path, monkeypatch):
+    """--seeds with ONE seed measures THAT seed — never silently replaced
+    by the default smoke seed (a seed-specific regression must not be
+    gated against the wrong trajectory)."""
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", str(tmp_path / "h.jsonl"))
+    measured_seeds = []
+
+    def fake_smoke(seed):
+        measured_seeds.append(seed)
+        return _synth(seed)
+    monkeypatch.setattr(perfgate, "measure_smoke", fake_smoke)
+    rc = perfgate.run(gate=True, current=None, out=io.StringIO(), seeds=[23])
+    assert rc == 0 and measured_seeds == [23]
+
+
+def test_inject_self_test_never_poisons_the_ledger(tmp_path, monkeypatch):
+    """The ACCORD_PERFGATE_INJECT_LATENCY self-test doctors the measured
+    latencies — its run must NOT append to BENCH_HISTORY.jsonl, where it
+    would read as a real 2x regression in every later trend report."""
+    ledger = tmp_path / "h.jsonl"
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", str(ledger))
+    monkeypatch.setenv("ACCORD_PERFGATE_INJECT_LATENCY", "2.0")
+    monkeypatch.setattr(perfgate, "measure_smoke", lambda seed=7: _synth(seed))
+    perfgate.run(gate=True, current=None, out=io.StringIO())
+    perfgate.run(gate=True, current=None, out=io.StringIO(), seeds=[1])
+    assert not ledger.exists(), "inject run leaked into the trend ledger"
+    # and a clean run still appends
+    monkeypatch.setenv("ACCORD_PERFGATE_INJECT_LATENCY", "1.0")
+    perfgate.run(gate=True, current=None, out=io.StringIO())
+    assert len(ledger.read_text().splitlines()) == 1
+
+
+def test_write_baseline_refuses_under_inject(monkeypatch, tmp_path):
+    """--write-baseline under the inject hook would record doctored
+    latencies as the baseline and silently defeat the gate forever —
+    it must refuse loudly."""
+    monkeypatch.setenv("ACCORD_PERFGATE_INJECT_LATENCY", "2.0")
+    with pytest.raises(RuntimeError, match="refusing --write-baseline"):
+        perfgate.write_baseline(str(tmp_path / "b.json"))
+
+
+def test_current_and_seeds_are_mutually_exclusive(measured):
+    """A saved --current artifact is one seed's measurement; combining it
+    with --seeds must fail loudly instead of silently re-measuring live."""
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        perfgate.run(gate=True, current=measured, out=io.StringIO(),
+                     seeds=[1, 2])
+    with pytest.raises(SystemExit):
+        perfgate.main(["--current", "x.json", "--seeds", "1,2"])

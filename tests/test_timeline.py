@@ -1,0 +1,241 @@
+"""Sim-time windowed telemetry (observe/timeline.py) and its contracts:
+
+1. ZERO OBSERVER EFFECT, extended: a same-seed hostile burn with timelines +
+   burn-rate monitors attached vs a bare run yields byte-identical full
+   message traces and identical outcomes — the PR-3 proof, re-proven for the
+   trajectory plane.
+2. EXACT WINDOWED PERCENTILES: every window's p50/p95/p99 equals the
+   nearest-rank percentile recomputed independently from the recorded span
+   latencies falling in that window, and the window counts partition the
+   whole-run registry histogram exactly.
+3. POLICY ENFORCEMENT: every metric feeds only under its declared
+   ``TIMELINE_POLICIES`` verb; excluded/undeclared metrics raise.
+"""
+import json
+import math
+
+import pytest
+
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+from cassandra_accord_tpu.observe import (BurnRateMonitor, FlightRecorder,
+                                          Timeline, commits_per_sec_series,
+                                          exact_percentile,
+                                          validate_chrome_trace)
+from cassandra_accord_tpu.observe import schema
+from cassandra_accord_tpu.observe.timeline import (service_window_records,
+                                                   write_timeline_jsonl)
+
+HOSTILE = dict(ops=40, concurrency=8, chaos=True, allow_failures=True,
+               durability=True, journal=True, delayed_stores=True,
+               clock_drift=True, max_tasks=3_000_000)
+
+
+def _nearest_rank(values, q):
+    """Independent nearest-rank percentile (the test's own formula)."""
+    vals = sorted(values)
+    if not vals:
+        return None
+    return vals[min(max(1, math.ceil(q * len(vals))), len(vals)) - 1]
+
+
+# ---------------------------------------------------------------------------
+# the zero-observer-effect proof, extended to timelines + burn-rate monitors
+# ---------------------------------------------------------------------------
+
+def test_zero_observer_effect_timeline_and_burnrate_hostile():
+    """Same-seed hostile burn: bare vs (timeline + burn-rate monitors)
+    attached — byte-identical full message traces, identical outcomes."""
+    ta, tb = Trace(), Trace()
+    bare = run_burn(9, tracer=ta.hook, **HOSTILE)
+    rec = FlightRecorder(timeline=Timeline(window_us=500_000),
+                         burnrate=BurnRateMonitor())
+    observed = run_burn(9, tracer=tb.hook, observer=rec, **HOSTILE)
+    divergence = diff_traces(ta, tb)
+    assert divergence is None, \
+        f"timeline/burnrate perturbed the simulation:\n{divergence}"
+    assert (bare.ops_ok, bare.ops_recovered, bare.ops_nacked, bare.ops_lost,
+            bare.ops_failed, bare.sim_micros) == \
+           (observed.ops_ok, observed.ops_recovered, observed.ops_nacked,
+            observed.ops_lost, observed.ops_failed, observed.sim_micros)
+    # and the trajectory plane actually recorded something
+    assert rec.timeline.records(), "no telemetry windows recorded"
+
+
+# ---------------------------------------------------------------------------
+# windowed percentiles: exact, cross-checked against the span latencies
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def windowed_burn():
+    tl = Timeline(window_us=1_000_000)
+    rec = FlightRecorder(timeline=tl)
+    res = run_burn(5, ops=120, concurrency=12, journal=True, durability=True,
+                   observer=rec)
+    return rec, tl, res
+
+
+def test_windowed_percentiles_match_exact_recompute(windowed_burn):
+    """Per window: the reported latency p50/p95/p99 equals the nearest-rank
+    percentile of the span latencies resolved inside that window, computed
+    independently here."""
+    rec, tl, _res = windowed_burn
+    by_window = {}
+    for span in rec.spans.client_spans():
+        if span.resolved_us is None:
+            continue
+        idx = span.resolved_us // tl.window_us
+        by_window.setdefault(idx, []).append(
+            span.resolved_us - span.submitted_us)
+    checked = 0
+    for r in tl.records():
+        pct = r["scopes"].get("cluster", {}).get("percentiles", {}) \
+            .get(schema.LATENCY_METRIC)
+        if pct is None:
+            continue
+        expected = by_window.get(r["window"], [])
+        assert pct["count"] == len(expected)
+        for q, key in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+            assert pct[key] == _nearest_rank(expected, q), \
+                f"window {r['window']} {key} mismatch"
+        assert pct["max"] == max(expected)
+        checked += 1
+    assert checked >= 1, "no window carried latency percentiles"
+
+
+def test_window_counts_partition_whole_run_histogram(windowed_burn):
+    """The per-window latency counts sum exactly to the whole-run registry
+    histogram's count, and each window's exact p99 is consistent with the
+    histogram's conservative bucket-upper-bound estimate (exact <= bound
+    whenever the bound exists)."""
+    rec, tl, res = windowed_burn
+    hist = rec.registry.histogram(schema.LATENCY_METRIC)
+    window_total = sum(
+        r["scopes"]["cluster"]["percentiles"][schema.LATENCY_METRIC]["count"]
+        for r in tl.records()
+        if schema.LATENCY_METRIC
+        in r["scopes"].get("cluster", {}).get("percentiles", {}))
+    assert window_total == hist.count == res.resolved
+    # whole-run exact percentile vs the registry's conservative bucket bound
+    latencies = sorted(s.resolved_us - s.submitted_us
+                       for s in rec.spans.client_spans()
+                       if s.resolved_us is not None)
+    for q in (0.5, 0.95, 0.99):
+        bound = hist.percentile(q)
+        if bound is not None:
+            assert exact_percentile(latencies, q) <= bound
+
+
+def test_windowed_rates_partition_registry_counters(windowed_burn):
+    """Summed per-window counts equal the registry's whole-run counters for
+    the submitted/resolved streams (the commits/s series is a partition of
+    the run, not a resample)."""
+    rec, tl, res = windowed_burn
+    recs = tl.records()
+    submitted = sum(
+        r["scopes"]["cluster"].get("counts", {}).get(schema.SUBMITTED_METRIC, 0)
+        for r in recs)
+    assert submitted == rec.registry.counter(schema.SUBMITTED_METRIC).value \
+        == res.ops_submitted
+    series = commits_per_sec_series(recs)
+    assert series, "no commits/s windows"
+    window_s = tl.window_us / 1e6
+    commits_from_series = round(sum(v for _w, v in series) * window_s)
+    assert commits_from_series == res.ops_ok + res.ops_recovered
+
+
+def test_node_and_store_scopes_recorded(windowed_burn):
+    _rec, tl, _res = windowed_burn
+    scopes = set()
+    for r in tl.records():
+        scopes.update(r["scopes"])
+    assert "cluster" in scopes
+    assert any(s.startswith("node/") for s in scopes)
+    assert any(s.startswith("store/") for s in scopes)
+
+
+# ---------------------------------------------------------------------------
+# ring bound + policy enforcement
+# ---------------------------------------------------------------------------
+
+def test_ring_bound_keeps_last_windows():
+    tl = Timeline(window_us=1_000, keep_windows=10)
+    for i in range(50):
+        tl.count("txn.submitted", now_us=i * 1_000)
+    recs = tl.records(include_open=False)
+    assert len(recs) == 10
+    assert tl.dropped_windows == 39   # 49 finalized, 10 kept
+    assert recs[-1]["window"] == 48   # the open window (49) is not finalized
+    assert recs[0]["window"] == 39
+
+
+def test_policy_enforced_at_feed_time():
+    tl = Timeline()
+    # wrong verb: a rate metric fed as a sample
+    with pytest.raises(ValueError, match="TIMELINE_POLICIES"):
+        tl.sample("txn.submitted", 1, now_us=0)
+    # excluded metrics refuse every verb
+    excluded = schema.RESOLVER_METRICS["device_consults"]
+    with pytest.raises(ValueError, match="excluded"):
+        tl.count(excluded, now_us=0)
+    # undeclared metrics raise actionably (the lint contract, live)
+    with pytest.raises(KeyError, match="TIMELINE_POLICIES"):
+        tl.count("bogus.metric", now_us=0)
+
+
+def test_exact_percentile_nearest_rank():
+    vals = list(range(1, 101))
+    assert exact_percentile(vals, 0.50) == 50
+    assert exact_percentile(vals, 0.95) == 95
+    assert exact_percentile(vals, 0.99) == 99
+    assert exact_percentile([7], 0.99) == 7
+    assert exact_percentile([], 0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# export surfaces: JSONL artifact + Perfetto counter track
+# ---------------------------------------------------------------------------
+
+def test_timeline_jsonl_artifact(tmp_path, windowed_burn):
+    rec, tl, _res = windowed_burn
+    path = tmp_path / "timeline.jsonl"
+    write_timeline_jsonl(str(path), rec)
+    lines = path.read_text().strip().splitlines()
+    header = json.loads(lines[0])
+    assert header["kind"] == "header"
+    assert header["schema"] == "accord-timeline/1"
+    assert header["window_us"] == tl.window_us
+    windows = [json.loads(l) for l in lines[1:]]
+    telemetry = [w for w in windows if "scopes" in w]
+    assert len(telemetry) == header["windows"]
+    assert all(w["end_us"] - w["start_us"] == tl.window_us for w in telemetry)
+
+
+def test_perfetto_timeline_counter_track(windowed_burn):
+    rec, _tl, _res = windowed_burn
+    doc = rec.chrome_trace()
+    assert validate_chrome_trace(doc) == []
+    track = [e for e in doc["traceEvents"]
+             if e.get("ph") == "C" and e.get("pid") == 0 and e.get("tid") == 2]
+    assert track, "timeline counter track missing"
+    assert any("commits_per_sec" in e["args"] for e in track)
+    assert any("latency_p99_ms" in e["args"] for e in track)
+    named = [e for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "thread_name"
+             and e["pid"] == 0 and e["tid"] == 2]
+    assert named and named[0]["args"]["name"] == "timeline"
+
+
+def test_service_window_records_from_samples():
+    """Consult-service trajectory windows derived from deterministic
+    (ts, depth, rows) samples — bucketed, max/mean per window."""
+    class _Rec:
+        _service_samples = [(100, 2, 8), (900, 5, 16), (1_500, 1, 4),
+                            (2_200, 3, 32)]
+    recs = service_window_records(_Rec(), window_us=1_000)
+    assert [r["window"] for r in recs] == [0, 1, 2]
+    assert recs[0]["queue_depth_max"] == 5
+    assert recs[0]["batch_rows_max"] == 16
+    assert recs[0]["dispatches"] == 2
+    assert recs[0]["batch_rows_mean"] == 12.0
+    assert all(r["kind"] == "service_window" for r in recs)

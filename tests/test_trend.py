@@ -1,0 +1,125 @@
+"""The cross-run trend ledger (tools/trend.py + BENCH_HISTORY.jsonl).
+
+Contracts: append/load round-trip (with the ACCORD_BENCH_HISTORY override
+and kill switch), torn-tail tolerance, delta rendering, the CLI's stdout
+TAIL contract (last line = one compact single-line JSON object, same as
+bench.py), and the perfgate integration (trend context printed; offline
+compares never append)."""
+import io
+import json
+import os
+import subprocess
+import sys
+
+from tools import trend
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _entry(i, mean):
+    return {"kind": "bench", "metric": "m", "value": mean,
+            "sim": {"commit_latency_mean_us": mean,
+                    "commit_latency_p95_us": mean * 2,
+                    "sim_ms": 1000 + i, "messages": 4000 + i}}
+
+
+def test_append_and_load_roundtrip(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    for i in range(3):
+        stamped = trend.append_entry(_entry(i, 100.0 + i), path=path)
+        assert stamped is not None and "ts" in stamped
+    entries = trend.load_history(path)
+    assert len(entries) == 3
+    assert entries[-1]["sim"]["commit_latency_mean_us"] == 102.0
+    assert all("ts" in e for e in entries)
+
+
+def test_env_override_and_kill_switch(tmp_path, monkeypatch):
+    target = str(tmp_path / "env.jsonl")
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", target)
+    assert trend.history_path() == target
+    trend.append_entry(_entry(0, 1.0))
+    assert len(trend.load_history()) == 1
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", "0")
+    assert trend.history_path() is None
+    assert trend.append_entry(_entry(1, 2.0)) is None   # disabled, no raise
+    assert trend.load_history() == []
+
+
+def test_torn_tail_lines_are_skipped(tmp_path):
+    path = tmp_path / "torn.jsonl"
+    path.write_text(json.dumps(_entry(0, 50.0)) + "\n"
+                    + '{"kind": "bench", "tru')       # killed mid-append
+    entries = trend.load_history(str(path))
+    assert len(entries) == 1
+
+
+def test_trend_lines_render_deltas(tmp_path):
+    path = str(tmp_path / "hist.jsonl")
+    trend.append_entry(_entry(0, 100.0), path=path)
+    trend.append_entry(_entry(1, 150.0), path=path)
+    lines = trend.trend_lines(trend.load_history(path))
+    text = "\n".join(lines)
+    assert "last 2 of 2 recorded runs" in text
+    assert "commit_latency_mean_us" in text
+    assert "(+50.0%)" in text
+    deltas = trend.latest_deltas(trend.load_history(path))
+    assert deltas["commit_latency_mean_us"] == 1.5
+
+
+def test_empty_history_renders_gracefully():
+    lines = trend.trend_lines([])
+    assert any("no runs recorded" in l for l in lines)
+    assert trend.latest_deltas([]) == {}
+
+
+def test_cli_stdout_tail_contract(tmp_path):
+    """The LAST stdout line of tools/trend.py is one compact single-line
+    JSON object (the bounded-tail-capture contract bench.py honors)."""
+    path = str(tmp_path / "hist.jsonl")
+    trend.append_entry(_entry(0, 100.0), path=path)
+    trend.append_entry(_entry(1, 110.0), path=path)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "trend.py"),
+         "--history", path],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    tail = json.loads(lines[-1])               # the harness's parse, exactly
+    assert tail["runs"] == 2 and tail["window"] == 2
+    assert tail["latest"]["sim"]["commit_latency_mean_us"] == 110.0
+    assert tail["deltas_vs_prev"]["commit_latency_mean_us"] == 1.1
+    assert len(lines[-1]) < 4096
+    # human-readable trend lines precede the tail
+    assert any("commit_latency_mean_us" in l for l in lines[:-1])
+
+
+def test_perfgate_prints_trend_and_offline_compare_never_appends(
+        tmp_path, monkeypatch):
+    """perfgate.run with a saved measurement (offline gating) must print the
+    trend context but NOT append to the ledger — only real measurements
+    grow the trajectory."""
+    from tools import perfgate
+    path = str(tmp_path / "hist.jsonl")
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", path)
+    trend.append_entry(_entry(0, 100.0))
+    current = {"sim": {k: 1000.0 for k, _t in perfgate.GATED_METRICS},
+               "wall": {}, "workload": {"seed": 7}}
+    out = io.StringIO()
+    rc = perfgate.run(gate=False, current=current, out=out)
+    assert rc == 0
+    text = out.getvalue()
+    assert "trend: last 1 of 1 recorded runs" in text
+    assert len(trend.load_history()) == 1, \
+        "offline compare appended to the ledger"
+
+
+def test_repo_ledger_exists_with_runs():
+    """The acceptance artifact: the repo's BENCH_HISTORY.jsonl carries at
+    least two appended runs and tools/trend.py renders their deltas."""
+    entries = trend.load_history(trend.DEFAULT_HISTORY_PATH)
+    assert len(entries) >= 2, \
+        "BENCH_HISTORY.jsonl missing or under-populated — run " \
+        "`python tools/perfgate.py --smoke` twice"
+    lines = trend.trend_lines(entries)
+    assert any("commit_latency_mean_us" in l for l in lines)

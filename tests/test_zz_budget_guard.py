@@ -24,13 +24,16 @@ TIER1_BUDGET_S = 870
 GUARD_THRESHOLD_S = 700
 
 
-def test_zz_perfgate_smoke_report(capsys):
+def test_zz_perfgate_smoke_report(capsys, monkeypatch, tmp_path):
     """Every verify run PRINTS (never gates) the commit-latency budget
     deltas vs BASELINE.json — tools/perfgate.py --smoke wired into the
     tier-1 tail.  The gated mode (bench.py --gate, exit-nonzero semantics)
     is covered by tests/test_perfgate.py; here a regression only shows up
     in the log, so budget creep is visible on every verify without making
-    tier-1 flaky."""
+    tier-1 flaky.  The trend-ledger append goes to a tmp path: a test run
+    must not dirty the checked-in BENCH_HISTORY.jsonl (real bench/gate
+    runs, not pytest invocations, grow the repo ledger)."""
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", str(tmp_path / "h.jsonl"))
     from tools import perfgate
     with capsys.disabled():   # the report IS the point: keep it in the log
         print()

@@ -65,24 +65,40 @@ GATED_METRICS = (
 )
 
 
-def measure_smoke() -> dict:
+def inject_factor() -> float:
+    """The ``ACCORD_PERFGATE_INJECT_LATENCY`` self-test multiplier (1.0 =
+    off; a malformed value raises — a doctored run must never pass for
+    clean).  Single source of truth for every consumer of the hook: the
+    measurement rescale below, the ledger-append guards here and in
+    bench.py, and ``write_baseline``'s refusal."""
+    return float(os.environ.get("ACCORD_PERFGATE_INJECT_LATENCY", "1.0"))
+
+
+def inject_active() -> bool:
+    """True when the self-test hook is doctoring measured latencies — such
+    runs must never reach the trend ledger or the baseline."""
+    return inject_factor() != 1.0
+
+
+def measure_smoke(seed: int = SMOKE_SEED) -> dict:
     """Run the smoke workload; returns the gate summary (sim plane + wall
-    plane + the latency budget's class shares)."""
+    plane + the latency budget's class shares).  ``seed`` parameterizes the
+    multi-seed mode — same workload shape, different trajectory."""
     from cassandra_accord_tpu.harness.burn import run_burn
     from cassandra_accord_tpu.observe import FlightRecorder, WallProfiler
     rec = FlightRecorder()
     prof = WallProfiler()
     t0 = time.perf_counter()
-    res = run_burn(SMOKE_SEED, observer=rec, profiler=prof, **SMOKE_KW)
+    res = run_burn(seed, observer=rec, profiler=prof, **SMOKE_KW)
     wall_s = time.perf_counter() - t0
     budget = rec.latency_budget()
     cluster_metrics = rec.metrics_snapshot()["cluster"]
     messages = sum(v for k, v in cluster_metrics.items()
                    if k.startswith("link.") and isinstance(v, int))
     wall = prof.report()
-    inject = float(os.environ.get("ACCORD_PERFGATE_INJECT_LATENCY", "1.0"))
+    inject = inject_factor()
     return {
-        "workload": dict(seed=SMOKE_SEED, **SMOKE_KW),
+        "workload": dict(seed=seed, **SMOKE_KW),
         "sim": {
             "commit_latency_mean_us":
                 round(budget["mean_commit_latency_us"] * inject, 1),
@@ -196,16 +212,143 @@ def compare(current: dict, baseline: Optional[dict]) \
     return lines, failures
 
 
+def baseline_sim_for(baseline: Optional[dict], seed: int) -> Optional[dict]:
+    """The baseline's sim block for one seed: the per-seed ``seeds`` table
+    when recorded (``--write-baseline --seeds``), else the default block for
+    the default smoke seed."""
+    if baseline is None:
+        return None
+    per_seed = baseline.get("seeds") or {}
+    if str(seed) in per_seed:
+        return per_seed[str(seed)].get("sim")
+    base_seed = (baseline.get("workload") or {}).get("seed", SMOKE_SEED)
+    if seed == base_seed:
+        return baseline.get("sim")
+    return None
+
+
+def compare_multi(per_seed: Dict[int, dict], baseline: Optional[dict]) \
+        -> Tuple[List[str], List[str]]:
+    """Multi-seed gating, per the KNOWN_ISSUES "trajectory sensitivity"
+    note: single-seed hostile trajectories are knife-edge chaotic, so the
+    gate judges the MEDIAN of the per-seed current/baseline ratios — one
+    chaotic seed cannot trip (or mask) a regression alone."""
+    import statistics
+    lines: List[str] = []
+    failures: List[str] = []
+    seeds = sorted(per_seed)
+    lines.append(f"perfgate multi-seed deltas (seeds {seeds}, gating on the "
+                 f"MEDIAN per-metric ratio):")
+    if baseline is None:
+        lines.append("  no baseline recorded — nothing gated")
+        return lines, failures
+    for key, thresh in GATED_METRICS:
+        ratios = []
+        per_seed_bits = []
+        for seed in seeds:
+            cur = per_seed[seed]["sim"].get(key)
+            base_sim = baseline_sim_for(baseline, seed) or {}
+            base = base_sim.get(key)
+            if cur is None or base is None or base == 0:
+                per_seed_bits.append(f"s{seed}:{cur}/{base}?")
+                continue
+            ratios.append(cur / base)
+            per_seed_bits.append(f"s{seed}:{cur / base:.3f}x")
+        if not ratios:
+            lines.append(f"  {key:<26} not comparable "
+                         f"({' '.join(per_seed_bits)}) — record per-seed "
+                         f"baselines with --write-baseline --seeds")
+            continue
+        med = statistics.median(ratios)
+        mark = ""
+        if med > thresh:
+            mark = f"  ** REGRESSION (median > {thresh:.2f}x)"
+            failures.append(f"{key}: median {med:.2f}x over "
+                            f"{len(ratios)} seeds (threshold {thresh:.2f}x)")
+        elif med < 1.0 / thresh:
+            mark = "  (improvement)"
+        lines.append(f"  {key:<26} median {med:.3f}x "
+                     f"({' '.join(per_seed_bits)}){mark}")
+    return lines, failures
+
+
+def _median_sim(per_seed: Dict[int, dict]) -> dict:
+    """Per-metric median of the sim planes (the trend-ledger record for a
+    multi-seed run)."""
+    import statistics
+    out = {}
+    for key, _thresh in GATED_METRICS:
+        vals = [s["sim"][key] for s in per_seed.values()
+                if s["sim"].get(key) is not None]
+        if vals:
+            out[key] = statistics.median(vals)
+    return out
+
+
+def _print_trend(out) -> None:
+    """The cross-run ledger context (tools/trend.py): the last-K recorded
+    runs' sim-metric trajectory, printed next to the baseline delta."""
+    try:
+        from tools.trend import load_history, trend_lines
+        entries = load_history()
+        for line in trend_lines(entries, last_k=5):
+            print(line, file=out, flush=True)
+    except Exception as e:  # noqa: BLE001 — trend context must not fail the gate
+        print(f"trend: <unavailable: {e!r}>", file=out, flush=True)
+
+
 def run(gate: bool, baseline_path: str = BASELINE_PATH,
-        current: Optional[dict] = None, out=None) -> int:
-    """Measure (unless ``current`` given), print deltas, return the exit
-    code (0, or EXIT_REGRESSION when ``gate`` and a threshold tripped)."""
+        current: Optional[dict] = None, out=None,
+        seeds: Optional[List[int]] = None) -> int:
+    """Measure (unless ``current`` given), print deltas + the cross-run
+    trend, return the exit code (0, or EXIT_REGRESSION when ``gate`` and a
+    threshold tripped).  ``seeds`` switches to per-seed measurement with
+    median gating (a single listed seed is measured AS THAT SEED — never
+    silently replaced by the default smoke seed) and is mutually exclusive
+    with ``current`` (an artifact carries one seed's measurement; re-running
+    live would gate the wrong tree state).  A measurement taken here is
+    appended to the trend ledger (BENCH_HISTORY.jsonl)."""
     out = out or sys.stdout
-    if current is None:
-        current = measure_smoke()
-    lines, failures = compare(current, load_baseline(baseline_path))
+    if seeds and current is not None:
+        raise ValueError("--current and --seeds are mutually exclusive: a "
+                         "saved artifact holds one seed's measurement; "
+                         "gate it with plain --current")
+    measured_here = current is None
+    history_record = None
+    if seeds:
+        per_seed = {}
+        for seed in seeds:
+            per_seed[seed] = measure_smoke(seed)
+            sim = per_seed[seed]["sim"]
+            print(f"perfgate seed {seed}: " + " ".join(
+                f"{k}={sim.get(k)}" for k, _t in GATED_METRICS),
+                file=out, flush=True)
+        lines, failures = compare_multi(per_seed, load_baseline(baseline_path))
+        history_record = {"kind": "perfgate", "seeds": sorted(per_seed),
+                          "sim": _median_sim(per_seed)}
+    else:
+        if current is None:
+            current = measure_smoke()
+        lines, failures = compare(current, load_baseline(baseline_path))
+        if measured_here:
+            history_record = {"kind": "perfgate",
+                              "seeds": [current["workload"]["seed"]],
+                              "sim": dict(current["sim"])}
     for line in lines:
         print(line, file=out, flush=True)
+    if inject_active():
+        # the documented self-test hook doctors the measured latencies — a
+        # ledger record of it would read as a real 2x regression in every
+        # later trend report
+        history_record = None
+    if history_record is not None:
+        # the ledger grows as a side effect of runs that already happen
+        try:
+            from tools.trend import append_entry
+            append_entry(history_record)
+        except Exception:  # noqa: BLE001 — the ledger must not fail the gate
+            pass
+    _print_trend(out)
     if failures:
         verdict = "perfgate: " + ("FAIL — " if gate else "regressions "
                                   "detected (print-only mode) — ") \
@@ -217,11 +360,26 @@ def run(gate: bool, baseline_path: str = BASELINE_PATH,
     return 0
 
 
-def write_baseline(path: str = BASELINE_PATH) -> dict:
-    """Measure and record the gate baseline into BASELINE.json['gate']."""
+def write_baseline(path: str = BASELINE_PATH,
+                   seeds: Optional[List[int]] = None) -> dict:
+    """Measure and record the gate baseline into BASELINE.json['gate'];
+    ``seeds`` additionally records a per-seed ``seeds`` table (the sim
+    planes the multi-seed median gate compares against)."""
     import datetime
+    if inject_active():
+        # a doctored baseline would make every future REAL regression gate
+        # clean — refuse loudly rather than record it
+        raise RuntimeError(
+            "refusing --write-baseline with ACCORD_PERFGATE_INJECT_LATENCY "
+            "set: the doctored latencies would become the baseline and "
+            "silently defeat the gate")
     summary = measure_smoke()
     summary["recorded"] = datetime.date.today().isoformat()
+    if seeds:
+        summary["seeds"] = {
+            str(seed): {"sim": (summary["sim"] if seed == SMOKE_SEED
+                                else measure_smoke(seed)["sim"])}
+            for seed in seeds}
     with open(path) as f:
         doc = json.load(f)
     doc["gate"] = summary
@@ -250,17 +408,32 @@ def main(argv=None) -> int:
     p.add_argument("--current", default=None, metavar="PATH",
                    help="compare a saved measure_smoke() summary instead of "
                         "measuring (offline gating of an artifact)")
+    p.add_argument("--seeds", default=None, metavar="A,B,C",
+                   help="multi-seed mode: measure every listed seed and "
+                        "gate on the MEDIAN per-metric ratio (per the "
+                        "KNOWN_ISSUES trajectory-sensitivity note that "
+                        "single-seed regressions are knife-edge chaotic); "
+                        "with --write-baseline, records the per-seed "
+                        "baseline table")
     args = p.parse_args(argv)
+    seeds = None
+    if args.seeds:
+        seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
     if args.write_baseline:
-        summary = write_baseline(args.baseline)
+        summary = write_baseline(args.baseline, seeds=seeds)
         print(json.dumps(summary["sim"], sort_keys=True))
-        print(f"perfgate: baseline written to {args.baseline}")
+        print(f"perfgate: baseline written to {args.baseline}"
+              + (f" (per-seed table for {seeds})" if seeds else ""))
         return 0
     current = None
     if args.current:
+        if seeds:
+            p.error("--current and --seeds are mutually exclusive (a saved "
+                    "artifact is one seed's measurement)")
         with open(args.current) as f:
             current = json.load(f)
-    return run(gate=args.gate, baseline_path=args.baseline, current=current)
+    return run(gate=args.gate, baseline_path=args.baseline, current=current,
+               seeds=seeds)
 
 
 if __name__ == "__main__":

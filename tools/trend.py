@@ -1,0 +1,235 @@
+"""trend — the cross-run performance trend ledger.
+
+Every bench / smoke / perf-gate run appends ONE JSON line to
+``BENCH_HISTORY.jsonl`` (the durable perf trajectory the one-shot
+``BENCH_r0*.json`` artifacts never gave us), and this tool renders the
+metric deltas across runs: run N vs run N−1 and vs the oldest run in the
+window, per metric, with the same deterministic sim-plane metrics the perf
+gate compares (``commit_latency_mean_us`` / ``p95`` / ``sim_ms`` /
+``messages``) plus each run's headline.
+
+Writers:
+- ``bench.py`` (all modes) appends its compact tail summary,
+- ``tools/perfgate.py --smoke/--gate`` appends the smoke measurement and
+  PRINTS the last-K trend next to its baseline delta,
+so the ledger grows as a side effect of runs that already happen — no new
+ritual.  ``ACCORD_BENCH_HISTORY`` overrides the ledger path (tests point it
+at a tmp file); set it to ``0`` to disable appends entirely.
+
+Stdout TAIL contract (same as bench.py, pinned by tests/test_trend.py): the
+LAST stdout line of the CLI is one compact single-line JSON object
+(run count + latest values + deltas), sized to survive a bounded tail
+capture.
+
+Usage:
+    python tools/trend.py                 # render the last 8 runs
+    python tools/trend.py --last 20
+    python tools/trend.py --history PATH
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+HISTORY_NAME = "BENCH_HISTORY.jsonl"
+DEFAULT_HISTORY_PATH = os.path.join(_REPO_ROOT, HISTORY_NAME)
+
+# the sim-plane metrics rendered as trend columns (the perf gate's own
+# deterministic set — tools/perfgate.py GATED_METRICS keys)
+TREND_SIM_KEYS = ("commit_latency_mean_us", "commit_latency_p95_us",
+                  "sim_ms", "messages")
+
+
+def history_path(path: Optional[str] = None) -> Optional[str]:
+    """Resolve the ledger path: explicit arg > ACCORD_BENCH_HISTORY env >
+    repo default.  Returns None when appends are disabled (env = 0/empty)."""
+    if path is not None:
+        return path
+    env = os.environ.get("ACCORD_BENCH_HISTORY")
+    if env is not None:
+        if env in ("", "0", "off"):
+            return None
+        return env
+    return DEFAULT_HISTORY_PATH
+
+
+def append_entry(record: dict, path: Optional[str] = None) -> Optional[dict]:
+    """Append one run record to the ledger (stamped with wall time — the
+    ledger is CROSS-run bookkeeping, explicitly outside the sim determinism
+    contract).  Never raises: the ledger must not be able to fail a bench
+    or gate run.  Returns the stamped record, or None when disabled."""
+    target = history_path(path)
+    if target is None:
+        return None
+    entry = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime()),
+             **record}
+    try:
+        with open(target, "a") as f:
+            f.write(json.dumps(entry, sort_keys=True) + "\n")
+    except OSError:
+        return None
+    return entry
+
+
+def load_history(path: Optional[str] = None) -> List[dict]:
+    """Parse the ledger; unparseable lines are skipped (a torn tail from a
+    killed run must not brick the trend report)."""
+    target = history_path(path)
+    if target is None:
+        return []
+    out: List[dict] = []
+    try:
+        with open(target) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(doc, dict):
+                    out.append(doc)
+    except OSError:
+        pass
+    return out
+
+
+def _sim_value(entry: dict, key: str):
+    sim = entry.get("sim")
+    if isinstance(sim, dict) and key in sim:
+        return sim[key]
+    return None
+
+
+def _cohort(entry: dict):
+    """The comparability key for run-over-run deltas: a multi-seed median
+    record and a single-seed record measure DIFFERENT things — a delta
+    between them reads as a regression on an unchanged tree.  Records
+    without a ``seeds`` field (legacy ledger lines) form their own cohort
+    and only compare with each other."""
+    seeds = entry.get("seeds")
+    if isinstance(seeds, list) and seeds:
+        return tuple(sorted(seeds))
+    return None
+
+
+def _fmt_delta(cur, prev) -> str:
+    if cur is None or prev is None:
+        return ""
+    if prev == 0:
+        return " (prev 0)"
+    ratio = cur / prev
+    sign = "+" if ratio >= 1 else ""
+    return f" ({sign}{100.0 * (ratio - 1):.1f}%)"
+
+
+def trend_lines(entries: List[dict], last_k: int = 8,
+                sim_keys=TREND_SIM_KEYS) -> List[str]:
+    """Human-readable last-K trend: one line per run, then per-metric delta
+    series run-over-run."""
+    window = entries[-last_k:]
+    lines: List[str] = []
+    if not window:
+        lines.append(f"trend: no runs recorded yet ({HISTORY_NAME} empty "
+                     f"or missing)")
+        return lines
+    lines.append(f"trend: last {len(window)} of {len(entries)} recorded runs")
+    for i, e in enumerate(window):
+        head = f"  [{i}] {e.get('ts', '?')} {e.get('kind', '?'):<8}"
+        seeds = e.get("seeds")
+        if isinstance(seeds, list) and seeds:
+            head += " seeds=" + ",".join(str(s) for s in seeds)
+        metric = e.get("metric")
+        if metric and e.get("value") is not None:
+            head += f" {metric}={e['value']}"
+        sims = [f"{k}={_sim_value(e, k)}" for k in sim_keys
+                if _sim_value(e, k) is not None]
+        if sims:
+            head += "  sim: " + " ".join(sims)
+        lines.append(head)
+    for key in sim_keys:
+        present = [(e, v) for e in window
+                   if (v := _sim_value(e, key)) is not None]
+        if len(present) < 2:
+            continue
+        # delta arrows only across SAME-cohort runs (same seed set): a
+        # multi-seed median vs a single-seed run is not a regression
+        cohort = _cohort(present[-1][0])
+        same = [v for e, v in present if _cohort(e) == cohort]
+        skipped = len(present) - len(same)
+        if len(same) < 2:
+            lines.append(f"  {key:<26} {same[-1]} (no prior same-seed run "
+                         f"to compare; {skipped} other-seed run(s))")
+            continue
+        parts = []
+        prev = None
+        for v in same:
+            parts.append(f"{v}{_fmt_delta(v, prev)}")
+            prev = v
+        tail = f"  [{skipped} other-seed run(s) omitted]" if skipped else ""
+        lines.append(f"  {key:<26} " + " -> ".join(parts) + tail)
+    return lines
+
+
+def latest_deltas(entries: List[dict],
+                  sim_keys=TREND_SIM_KEYS) -> Dict[str, float]:
+    """Per-metric current/previous ratio of the two most recent SAME-cohort
+    runs that carry each metric (the tail-contract JSON payload).  Cohort =
+    the record's seed set: comparing a multi-seed median against a
+    single-seed run would report a spurious delta on an unchanged tree."""
+    out: Dict[str, float] = {}
+    for key in sim_keys:
+        present = [(e, v) for e in entries
+                   if (v := _sim_value(e, key)) is not None]
+        if not present:
+            continue
+        cohort = _cohort(present[-1][0])
+        series = [v for e, v in present if _cohort(e) == cohort]
+        if len(series) >= 2 and series[-2]:
+            out[key] = round(series[-1] / series[-2], 4)
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--last", type=int, default=8, metavar="K",
+                   help="render the last K runs (default 8)")
+    p.add_argument("--history", default=None, metavar="PATH",
+                   help=f"ledger path (default: repo {HISTORY_NAME}, or "
+                        f"ACCORD_BENCH_HISTORY)")
+    args = p.parse_args(argv)
+    entries = load_history(args.history)
+    for line in trend_lines(entries, last_k=args.last):
+        print(line, flush=True)
+    window = entries[-args.last:]
+    latest = window[-1] if window else None
+    # stdout TAIL contract: the LAST line is one compact single-line JSON
+    # object (the same bounded-tail-capture contract bench.py honors)
+    summary = {
+        "runs": len(entries),
+        "window": len(window),
+        "latest": None if latest is None else {
+            "ts": latest.get("ts"), "kind": latest.get("kind"),
+            "metric": latest.get("metric"), "value": latest.get("value"),
+            "sim": {k: _sim_value(latest, k) for k in TREND_SIM_KEYS
+                    if _sim_value(latest, k) is not None} or None,
+        },
+        "deltas_vs_prev": latest_deltas(entries),
+    }
+    print(json.dumps(summary, sort_keys=True), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
