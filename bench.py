@@ -73,6 +73,66 @@ def bench_protocol(resolver: str, batch_window_us: int, ops: int = PROTO_OPS,
 
 
 # ---------------------------------------------------------------------------
+# protocol ramp: commits/s vs in-flight concurrency, columnar on vs off
+# ---------------------------------------------------------------------------
+
+RAMP_LEVELS = (8, 32, 128)
+RAMP_OPS = 400
+
+
+def bench_protocol_ramp(levels=RAMP_LEVELS, ops: int = RAMP_OPS):
+    """The ROADMAP item-1 oracle: ``protocol_commits_per_sec`` must SCALE
+    with in-flight concurrency instead of flatlining.  Runs the fixed-seed
+    contended workload at each concurrency level with the columnar protocol
+    engine on and off.  Two rates per run:
+
+    - ``sim``: commits per SIM second — deterministic, identical on-vs-off
+      by the engine's byte-identity contract; this is the protocol-level
+      scaling curve (the round-10 timeline ramp oracle);
+    - ``wall``: commits per WALL second — the machine-dependent number the
+      43-commits/s wall was measured in; columnar on-vs-off deltas here are
+      the engine's whole point.
+    """
+    from cassandra_accord_tpu.harness.burn import run_burn
+    out = {"levels": list(levels), "ops": ops, "seed": PROTO_SEED,
+           "workload": dict(ops=ops, seed=PROTO_SEED, **PROTO_KW)}
+    # warm the process (imports, allocator) so the first measured mode
+    # doesn't eat the cold start, and INTERLEAVE modes per level — a
+    # mode-major order systematically biases against whichever runs first
+    run_burn(seed=PROTO_SEED, ops=40, concurrency=levels[0], **PROTO_KW)
+    rates = {"on": {"wall": [], "sim": []}, "off": {"wall": [], "sim": []}}
+    for conc in levels:
+        for mode in ("on", "off"):
+            t0 = time.perf_counter()
+            res = run_burn(seed=PROTO_SEED, ops=ops, concurrency=conc,
+                           columnar=mode, **PROTO_KW)
+            dt = time.perf_counter() - t0
+            rates[mode]["wall"].append(round(res.ops_ok / dt, 1)
+                                       if dt else None)
+            rates[mode]["sim"].append(
+                round(res.ops_ok / (res.sim_micros / 1e6), 1)
+                if res.sim_micros else None)
+            if mode == "on":
+                out["columnar_stats"] = {
+                    k: v for k, v in res.stats.items()
+                    if k.startswith("columnar_")}
+    for mode in ("on", "off"):
+        out[f"columnar_{mode}"] = {
+            "commits_per_sec_wall": rates[mode]["wall"],
+            "commits_per_sec_sim": rates[mode]["sim"]}
+    on = out["columnar_on"]
+    sim = on["commits_per_sec_sim"]
+    wall = on["commits_per_sec_wall"]
+    out["protocol_commits_per_sec"] = wall[-1]
+    out["sim_ramp_scaling"] = round(sim[-1] / sim[0], 3) \
+        if sim[0] and sim[-1] else None
+    off_wall = out["columnar_off"]["commits_per_sec_wall"]
+    out["columnar_wall_speedup"] = [
+        round(a / b, 3) if a and b else None for a, b in zip(wall, off_wall)]
+    return out
+
+
+# ---------------------------------------------------------------------------
 # kernel-level: fused consult vs vectorized-numpy host at scale
 # ---------------------------------------------------------------------------
 
@@ -461,6 +521,21 @@ def emit_and_exit(code=0):
                 "incomplete": RESULT["detail"].get("incomplete", True),
                 "sim": smoke.get("sim"),
             }
+            ramp = RESULT["detail"].get("protocol_ramp")
+            if ramp:
+                # the ledger's protocol_commits_per_sec series
+                # (tools/trend.py renders it run-over-run): wall rate at the
+                # top concurrency level with the columnar engine on, plus
+                # the full ramp curve for the record
+                record["protocol_commits_per_sec"] = \
+                    ramp.get("protocol_commits_per_sec")
+                record["ramp"] = {
+                    "levels": ramp.get("levels"),
+                    "wall": (ramp.get("columnar_on") or {})
+                    .get("commits_per_sec_wall"),
+                    "sim": (ramp.get("columnar_on") or {})
+                    .get("commits_per_sec_sim"),
+                }
             # the seed cohort keys run-over-run comparability in
             # tools/trend.py — a bench smoke record and a perfgate record
             # of the same seed are the same measurement
@@ -539,6 +614,28 @@ def smoke_main():
     emit_and_exit(0)
 
 
+def ramp_main():
+    """``bench.py --ramp``: just the protocol_ramp stage (minutes-class),
+    same fail-open staging + single-line-JSON stdout tail contract."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGALRM, _on_term)
+    signal.alarm(max(60, int(DEADLINE - time.monotonic()) - 30))
+    d = RESULT["detail"]
+
+    def ramp():
+        out = bench_protocol_ramp()
+        d["protocol_ramp"] = out
+        RESULT["metric"] = "protocol_commits_per_sec"
+        RESULT["unit"] = "commits/s"
+        RESULT["value"] = out["protocol_commits_per_sec"]
+        speedups = [s for s in out["columnar_wall_speedup"] if s]
+        if speedups:
+            RESULT["vs_baseline"] = speedups[-1]   # columnar on/off, top level
+    stage("protocol_ramp", ramp)
+    d["incomplete"] = "protocol_ramp" not in d
+    emit_and_exit(0)
+
+
 def gate_main():
     """``bench.py --gate``: run the smoke measurement and compare against
     BASELINE.json's gate block (tools/perfgate.py) — per-metric deltas on
@@ -608,6 +705,16 @@ def main():
             "tpu_resolver_telemetry": tel,
         }
     stage("protocol", proto)
+
+    def ramp():
+        # the ROADMAP item-1 ramp oracle: commits/s vs in-flight, columnar
+        # engine on vs off (the sim curve must SCALE, the wall delta is the
+        # engine's earnings)
+        return bench_protocol_ramp()
+
+    rp = stage("protocol_ramp", ramp)
+    if rp is not None:
+        d["protocol_ramp"] = rp
 
     def protocol_slo():
         # latency-SLO workload judged by the flight-recorder/auditor plane
@@ -785,9 +892,16 @@ if __name__ == "__main__":
                     help="smoke measurement + regression gate vs "
                          "BASELINE.json (tools/perfgate.py): prints "
                          "per-metric deltas, exits nonzero past thresholds")
+    _p.add_argument("--ramp", action="store_true",
+                    help="just the protocol_ramp stage: commits/s at "
+                         f"concurrency {RAMP_LEVELS}, columnar engine on "
+                         "vs off; appends the protocol_commits_per_sec "
+                         "series to BENCH_HISTORY.jsonl")
     _args = _p.parse_args()
     if _args.gate:
         gate_main()
+    elif _args.ramp:
+        ramp_main()
     elif _args.smoke:
         smoke_main()
     else:

@@ -121,6 +121,14 @@ class LocalConfig:
     slow_peer_latency_threshold_s: float = 1.0
     slow_peer_penalty_s: float = 5.0
 
+    # -- columnar protocol engine (protocol_batch/) ---------------------------
+    # struct-of-arrays txn batches over command-store hot state + vectorized
+    # release/frontier/progress scans.  "off" keeps every legacy code path
+    # untouched; "on"/"auto" enable the engine — which by the exact-skip
+    # contract NEVER changes a protocol decision (same-seed burns on-vs-off
+    # are byte-identical; the knob buys wall-clock, never trajectory)
+    columnar: str = "auto"                  # auto | on | off
+
     # -- deps-resolver data plane (impl/resolver.py, impl/tpu_resolver.py) ---
     resolver_kind: str = "cpu"              # cpu | tpu | verify
     tpu_txn_slots: int = 64
@@ -162,6 +170,7 @@ class LocalConfig:
         ("ACCORD_JOURNAL_CORRUPT_CHANCE", "journal_corrupt_chance", float),
         ("ACCORD_REPLY_BACKOFF_MAX", "reply_backoff_max_s", float),
         ("ACCORD_REPLY_REARM_BUDGET", "reply_rearm_budget", int),
+        ("ACCORD_COLUMNAR", "columnar", lambda v: v.lower()),
         ("ACCORD_RESOLVER", "resolver_kind", lambda v: v.lower()),
         ("ACCORD_TPU_TXN_SLOTS", "tpu_txn_slots", int),
         ("ACCORD_TPU_KEY_SLOTS", "tpu_key_slots", int),

@@ -145,6 +145,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              pause_nodes: bool = False,
              disk_stall: bool = False,
              stall_watchdog_s: Optional[float] = None,
+             columnar: Optional[str] = None,
              node_config=None,
              max_tasks: int = 20_000_000,
              tracer=None, on_submit=None, consult_recorder=None,
@@ -242,6 +243,14 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             "journal logs by store id, and multi-store range assignment " \
             "is not stable across a restart boundary"
     cfg = node_config if node_config is not None else LocalConfig.from_env()
+    if columnar is not None:
+        # the columnar protocol engine knob (protocol_batch/): auto|on|off.
+        # By the exact-skip contract the knob NEVER changes a trajectory —
+        # same-seed runs on-vs-off are byte-identical (tests/
+        # test_protocol_batch.py) — so overriding it here is always safe
+        from dataclasses import replace as _dc_replace
+        cfg = _dc_replace(cfg, columnar=columnar)
+        node_config = cfg
 
     # shard the key space into rf-replicated ranges over the nodes
     n_ranges = max(1, n_nodes // max(1, rf // 2))
@@ -739,6 +748,18 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         result.stats["tfk_inversions"] = sum(
             cs.tfk_inversions for node in cluster.nodes.values()
             for cs in node.command_stores.all_stores())
+        # columnar-engine effectiveness counters (deterministic given the
+        # trajectory — the engine never CHANGES the trajectory): how many
+        # scalar visits the vectorized passes proved skippable
+        col_stats: Dict[str, int] = {}
+        for node in cluster.nodes.values():
+            for cs in node.command_stores.all_stores():
+                if cs.batch_engine is not None:
+                    for k2, v in cs.batch_engine.stats.items():
+                        col_stats[k2] = col_stats.get(k2, 0) + v
+        if col_stats:
+            result.stats.update({f"columnar_{k2}": v
+                                 for k2, v in col_stats.items()})
         if cache_miss:
             result.stats["cache_miss_loads"] = sum(
                 cs.cache_miss_loads for node in cluster.nodes.values()
@@ -875,6 +896,14 @@ def main(argv=None) -> None:
     p.add_argument("--nodes", type=int, default=None)
     p.add_argument("--resolver", default=None,
                    choices=[None, "cpu", "tpu", "verify"])
+    p.add_argument("--columnar", default=None,
+                   choices=[None, "auto", "on", "off"],
+                   help="columnar protocol engine (protocol_batch/): "
+                        "struct-of-arrays txn batches + vectorized release/"
+                        "frontier/progress scans.  Trajectory-neutral by "
+                        "contract (same-seed on-vs-off burns are byte-"
+                        "identical); default: LocalConfig/ACCORD_COLUMNAR "
+                        "(auto = on)")
     p.add_argument("--benign", action="store_true",
                    help="disable the chaos network")
     p.add_argument("--no-churn", action="store_true",
@@ -1076,6 +1105,7 @@ def main(argv=None) -> None:
                   pause_nodes=not args.no_pause,
                   disk_stall=not args.no_disk_stall,
                   stall_watchdog_s=watchdog_s,
+                  columnar=args.columnar,
                   node_config=cfg,
                   max_tasks=200_000_000)
         observer = None

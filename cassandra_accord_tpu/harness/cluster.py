@@ -1320,6 +1320,14 @@ class Cluster:
         # the (priority, arrival, seq) key keeps it deterministic.
         with_specs.sort(key=lambda p: (not p[1], p[0][0], p[0][1]))
         for store, specs in per_store.items():
+            engine = getattr(store, "batch_engine", None)
+            if engine is not None:
+                # columnar ingress accounting: the delivery window IS the
+                # per-tick batch the engine's ConsultBatch bridge packs
+                # (protocol_batch/engine.consult_ingress); counted here so
+                # the ramp bench can report rows-per-window amortization
+                engine.stats["ingress_windows"] += 1
+                engine.stats["ingress_rows"] += len(specs)
             store.resolver.prefetch(specs)
         try:
             for (_at, _seq, request, frm, ctx), _h in with_specs:

@@ -23,6 +23,7 @@ from typing import TYPE_CHECKING, Dict, Optional
 
 from ..api.interfaces import ProgressLog
 from ..local.status import SaveStatus, Status
+from ..protocol_batch.columns import ENGAGE_FLOOR
 from ..primitives.route import Route
 from ..primitives.timestamp import TxnId
 from ..utils.invariants import check_state
@@ -221,28 +222,52 @@ class SimpleProgressLog(ProgressLog):
             delay, lambda: self.store.execute(lambda _s: launch()))
 
     def _poll_in_store(self) -> None:
+        if not self.coordinating and not self.blocking and not self.non_home:
+            return   # nothing monitored: skip the import + scan setup
         from ..coordinate.maybe_recover import ProgressToken
 
-        for txn_id in list(self.coordinating.keys()):
+        # columnar settlement pre-scan (protocol_batch/): ONE vectorized
+        # gather answers "settled / outcome known / resident" for every
+        # monitored id instead of a per-txn lookup + attribute chase.  Only
+        # RESIDENT rows are decided from the mirror — for those the scalar
+        # ``store.lookup`` is a pure dict hit, so skipping it skips no
+        # fault-in; non-resident ids take the scalar path unchanged (their
+        # lookup may fault evicted state in, which is observable).
+        engine = self.store.batch_engine
+        coord_ids = list(self.coordinating.keys())
+        done_m = outcome_m = resident_m = None
+        if engine is not None and len(coord_ids) >= ENGAGE_FLOOR:
+            done_m, outcome_m, resident_m = engine.settled_partition(coord_ids)
+        for i, txn_id in enumerate(coord_ids):
             state = self.coordinating.get(txn_id)
             if state is None or state.progress is Progress.INVESTIGATING:
                 continue
-            command = self.store.lookup(txn_id)
-            if command is not None and (
-                    command.save_status.ordinal >= SaveStatus.APPLIED.ordinal):
-                self._done(txn_id)
-                continue
-            if command is not None \
-                    and command.save_status.ordinal >= SaveStatus.PRE_APPLIED.ordinal:
-                # the OUTCOME is already known locally: nothing to recover —
-                # the txn is waiting on its deps' applies, which the blocked-
-                # dep machinery drives.  Launching recoveries here is what
-                # starves applies behind recovery churn (the PRE_APPLIED-
-                # backlog livelock class: each recovery preempts coordinators
-                # actually draining the chain; the reference's ladder gates
-                # investigation while a txn is advancing,
-                # SimpleProgressLog.java:228-340)
-                continue
+            if resident_m is not None and resident_m[i]:
+                if done_m[i]:
+                    self._done(txn_id)
+                    continue
+                if outcome_m[i]:
+                    continue
+                command = self.store.commands.get(txn_id)  # resident: dict hit
+            else:
+                command = self.store.lookup(txn_id)
+                if command is not None and (
+                        command.save_status.ordinal
+                        >= SaveStatus.APPLIED.ordinal):
+                    self._done(txn_id)
+                    continue
+                if command is not None and command.save_status.ordinal \
+                        >= SaveStatus.PRE_APPLIED.ordinal:
+                    # the OUTCOME is already known locally: nothing to
+                    # recover — the txn is waiting on its deps' applies,
+                    # which the blocked-dep machinery drives.  Launching
+                    # recoveries here is what starves applies behind
+                    # recovery churn (the PRE_APPLIED-backlog livelock
+                    # class: each recovery preempts coordinators actually
+                    # draining the chain; the reference's ladder gates
+                    # investigation while a txn is advancing,
+                    # SimpleProgressLog.java:228-340)
+                    continue
             local_token = None if command is None else ProgressToken(
                 command.durability, command.save_status.ordinal, command.promised)
             if state.token is None or (local_token is not None
@@ -256,14 +281,27 @@ class SimpleProgressLog(ProgressLog):
             state.progress = Progress.INVESTIGATING
             self._launch_staggered(lambda state=state: self._investigate(state))
 
-        for txn_id in list(self.blocking.keys()):
+        # blocking map: the resolved check is the only consumer of the
+        # command object, so resident rows answer it entirely from the
+        # mirror — no per-txn lookup at all for the (typically large under
+        # chaos) still-blocked majority
+        block_ids = list(self.blocking.keys())
+        resolved_m = bresident_m = None
+        if engine is not None and len(block_ids) >= ENGAGE_FLOOR:
+            resolved_m, bresident_m = engine.resolved_partition(block_ids)
+        for i, txn_id in enumerate(block_ids):
             state = self.blocking.get(txn_id)
             if state is None or state.progress is Progress.INVESTIGATING:
                 continue
-            command = self.store.lookup(txn_id)
-            if command is not None and self._locally_resolved(command):
-                self.blocking.pop(txn_id, None)
-                continue
+            if bresident_m is not None and bresident_m[i]:
+                if resolved_m[i]:
+                    self.blocking.pop(txn_id, None)
+                    continue
+            else:
+                command = self.store.lookup(txn_id)
+                if command is not None and self._locally_resolved(command):
+                    self.blocking.pop(txn_id, None)
+                    continue
             if state.progress is Progress.EXPECTED:
                 # freshly blocked: give the normal pipeline one poll cycle
                 state.progress = Progress.NO_PROGRESS

@@ -639,8 +639,14 @@ class TpuDepsResolver(DepsResolver):
             for sig, op, known, before in live:
                 cols = [self.key_slot[rk] for rk in known]
                 if op == "kc":
+                    # txn_lanes: the querying TxnId in the ConsultBatch's
+                    # txn_rows attribution lanes — the field the ragged
+                    # ingress contract reserved for the columnar protocol
+                    # batches (device_service/batch.py doc; the kernel does
+                    # not read it, so answers are unchanged)
                     self._cache[sig] = svc.submit(
                         cols, _pack_before(before), int(sig[1].kind),
+                        txn_lanes=sig[1].pack_lanes(),
                         post=self._post_kc(known))
                 else:
                     self._cache[sig] = svc.submit(

@@ -87,7 +87,7 @@ class CommandsForKey:
     __slots__ = ("key", "by_id", "prune_before", "_max_applied_write",
                  "_max_applied_write_tid", "_unmanaged_waiting",
                  "_committed_writes", "cold", "_cold_max_ea", "_cold_max_tid",
-                 "_pruned_max")
+                 "_pruned_max", "_merged_cache")
 
     def __init__(self, key: RoutingKey):
         self.key = key
@@ -115,6 +115,13 @@ class CommandsForKey:
         self._cold_max_tid: Optional[TxnId] = None      # max tid of emittable cold
         self._pruned_max: Optional[Timestamp] = None    # max ts floor of removed
         self._max_applied_write_tid: Optional[TxnId] = None
+        # memo of the cold+hot MERGED walk order (sync-point / stale-bound
+        # queries): rebuilding sorted(cold + by_id) per query was
+        # O(history log history) PER SYNC-POINT QUERY PER KEY.  Holds
+        # TxnInfo REFERENCES, so in-place status upgrades stay visible
+        # (txn_id order never changes); any MEMBERSHIP change invalidates
+        # (_demote / _prune / hot insert)
+        self._merged_cache: Optional[List[TxnInfo]] = None
 
     # -- lookup -------------------------------------------------------------
     def get(self, txn_id: TxnId) -> Optional[TxnInfo]:
@@ -188,6 +195,7 @@ class CommandsForKey:
                 info.execute_at = execute_at
         else:
             self.by_id.insert(i, probe)
+            self._merged_cache = None     # membership changed
             self._maybe_index_committed_write(probe, None)
         if status is InternalStatus.APPLIED and txn_id.is_write:
             ea = execute_at if execute_at is not None else txn_id
@@ -265,8 +273,14 @@ class CommandsForKey:
             # sync-point query or stale bound — take the merged walk,
             # bit-identical to an unsplit index.  Common bounds from normal
             # txns sit above every cold entry's covering write and walk the
-            # hot tier only: O(concurrency), not O(history).
-            entries = sorted(list(self.cold.values()) + self.by_id)
+            # hot tier only: O(concurrency), not O(history).  The merged
+            # order is memoized (columnar-engine round): every exclusive
+            # sync point re-sorted the key's WHOLE history per deps query
+            # before this, O(history log history) per fence per key.
+            entries = self._merged_cache
+            if entries is None:
+                entries = self._merged_cache = sorted(
+                    list(self.cold.values()) + self.by_id)
         for info in entries:
             if info.txn_id >= before:
                 break
@@ -391,6 +405,7 @@ class CommandsForKey:
 
     def _demote(self, info: TxnInfo) -> None:
         self.cold[info.txn_id] = info
+        self._merged_cache = None         # membership changed (hot -> cold)
         self._note_removed_max(info)
         if info.status is not InternalStatus.INVALIDATED:
             ea = info.execute_at
@@ -452,6 +467,7 @@ class CommandsForKey:
                 highest = txn_id
         if pruned:
             self.by_id = keep
+            self._merged_cache = None     # membership changed
             self.prune_before = highest
             gone = set(pruned)
             self._committed_writes = [e for e in self._committed_writes

@@ -16,6 +16,7 @@ from typing import TYPE_CHECKING, Callable, Dict, List, Optional, Set, Tuple
 
 from ..api.interfaces import Agent, DataStore, ProgressLog
 from ..primitives.deps import Deps
+from ..protocol_batch.columns import ENGAGE_FLOOR
 from ..primitives.keys import Range, Ranges, RoutingKey
 from ..primitives.route import Route
 from ..primitives.timestamp import Timestamp, TxnId, TxnKind
@@ -116,6 +117,7 @@ class CommandStore:
         self.executor = executor
         # epoch -> Ranges this store covers (RangesForEpoch)
         self.ranges_by_epoch: Dict[int, Ranges] = {}
+        self._all_ranges_cache: Optional[Ranges] = None
         self.commands: Dict[TxnId, Command] = {}
         self.cfks: Dict[RoutingKey, CommandsForKey] = {}
         # witnessed range-domain txns: TxnId -> (Ranges, status) for range deps calc
@@ -173,6 +175,15 @@ class CommandStore:
         from ..impl.resolver import make_resolver
         self.resolver = make_resolver(getattr(node, "resolver_kind", "cpu"),
                                       self, config=getattr(node, "config", None))
+        # the columnar protocol engine (protocol_batch/): a struct-of-arrays
+        # mirror of this store's hot command state + vectorized passes over
+        # it (release fan-out, frontier classification, progress scans).
+        # None when columnar=off: every legacy code path stays untouched.
+        # Exact-skip contract: the engine never changes a protocol decision
+        # (same-seed burns columnar on-vs-off are byte-identical, proven by
+        # tests/test_protocol_batch.py).
+        from ..protocol_batch import make_engine
+        self.batch_engine = make_engine(self)
 
     def observer(self):
         """The run's flight recorder (observe.FlightRecorder), or None.
@@ -183,6 +194,7 @@ class CommandStore:
     # -- ranges -------------------------------------------------------------
     def update_ranges(self, epoch: int, ranges: Ranges) -> None:
         self.ranges_by_epoch[epoch] = ranges
+        self._all_ranges_cache = None   # the only mutation site
 
     def ranges_at(self, epoch: int) -> Ranges:
         """Ranges covered at ``epoch`` (latest known at-or-before epoch)."""
@@ -228,12 +240,26 @@ class CommandStore:
                 cmd.elided_unapplied = set(summary.elided_unapplied)
             self.commands[txn_id] = cmd
             self.cache_miss_loads += 1
+            if self.batch_engine is not None:
+                # the reload made the command resident again: re-mirror it so
+                # the columnar scans see it (absence would only cost speed —
+                # unknown rows take the scalar path — but residency tracking
+                # must never claim a row for an evicted command, so the
+                # mirror follows residency in BOTH directions)
+                self.batch_engine.note_fault_in(cmd)
         return cmd
 
     def all_ranges(self) -> Ranges:
-        out = Ranges.EMPTY
-        for r in self.ranges_by_epoch.values():
-            out = out.union(r)
+        """Union of every epoch's owned ranges.  Memoized: this sits on the
+        frontier-init / elision / apply hot paths (tens of thousands of
+        calls per burn) and re-unioned the whole epoch map per call;
+        ``update_ranges`` is the only mutation site and invalidates."""
+        out = self._all_ranges_cache
+        if out is None:
+            out = Ranges.EMPTY
+            for r in self.ranges_by_epoch.values():
+                out = out.union(r)
+            self._all_ranges_cache = out
         return out
 
     def unapplied_pressure(self, min_age_s: float = 10.0,
@@ -383,6 +409,10 @@ class SafeCommandStore:
         del store.commands[txn_id]
         store.cold.add(txn_id)
         store.cold_summaries[txn_id] = CommandSummary(cmd)
+        if store.batch_engine is not None:
+            # residency left: the columnar mirror must forget the row, or a
+            # vectorized scan would skip the fault-in the scalar path takes
+            store.batch_engine.drop(txn_id)
         store.journal.on_evict(store, txn_id)
         obs = store.observer()
         if obs is not None:
@@ -510,6 +540,12 @@ class SafeCommandStore:
                 and self.cfk(rk).update(command.txn_id, status, ea))
             if indexed:
                 self.store.resolver.register(command.txn_id, status, ea, indexed)
+                engine = self.store.batch_engine
+                if engine is not None:
+                    # the key-set offsets plane of the columnar layout (the
+                    # ConsultBatch ingress bridge reads these CSR rows)
+                    engine.note_keys(command.txn_id,
+                                     [engine.key_slot(rk) for rk in indexed])
 
     def mark_txn_durable(self, command: Command) -> None:
         """Per-txn majority durability (InformDurable after the coordinator's
@@ -542,12 +578,39 @@ class SafeCommandStore:
         self.store.transient_listeners.setdefault(txn_id, []).append(callback)
 
     def notify_listeners(self, command: Command) -> None:
-        """Fire command-listeners (dependent txns) and transient listeners."""
+        """Fire command-listeners (dependent txns) and transient listeners.
+
+        With the columnar engine, the per-waiter release checks run as ONE
+        batched pass over the listener set first (the vectorized
+        ``remove_waiting`` fan-out): waiters the mirror PROVES still-blocked
+        skip their scalar visit — the visit would read state and return
+        without any mutation, observation, or fault-in (the skip proof is in
+        BatchEngine.release_skip_mask).  A cascade that advances this
+        command mid-fan-out invalidates the proof, so the dep snapshot is
+        re-validated between visits; on any change the remaining waiters
+        take the scalar path."""
         from . import commands as C
-        for waiter_id in list(command.listeners):
-            waiter = self.get_if_exists(waiter_id)
-            if waiter is not None:
-                C.update_dependency_and_maybe_execute(self, waiter, command)
+        listener_ids = list(command.listeners)
+        engine = self.store.batch_engine
+        skip = None
+        if engine is not None and len(listener_ids) >= ENGAGE_FLOOR:
+            skip = engine.release_skip_mask(command, listener_ids)
+        if skip is None:
+            for waiter_id in listener_ids:
+                waiter = self.get_if_exists(waiter_id)
+                if waiter is not None:
+                    C.update_dependency_and_maybe_execute(self, waiter, command)
+        else:
+            snap = engine.release_snapshot(command)
+            valid = True
+            for i, waiter_id in enumerate(listener_ids):
+                if valid and skip[i]:
+                    if engine.release_snapshot(command) == snap:
+                        continue
+                    valid = False   # dep advanced mid-fan-out: proof void
+                waiter = self.get_if_exists(waiter_id)
+                if waiter is not None:
+                    C.update_dependency_and_maybe_execute(self, waiter, command)
         for cb in list(self.store.transient_listeners.get(command.txn_id, ())):
             cb(self, command)
 
@@ -673,6 +736,8 @@ class SafeCommandStore:
                     # then recover@[146] committed via n5's old accept).
                     del store.commands[txn_id]
                     store.transient_listeners.pop(txn_id, None)
+                    if store.batch_engine is not None:
+                        store.batch_engine.drop(txn_id)
                     if store.journal is not None:
                         store.journal.erase(store, txn_id)
                     continue
@@ -793,6 +858,14 @@ class CommandStores:
         stores = self.intersecting_stores(unseekables, min_epoch, max_epoch)
         if not stores:
             return au.done(None)
+        if len(stores) == 1:
+            # fast path (the PR-8 loop-is-the-wall finding): the common
+            # single-shard routing built three AsyncChain layers per message
+            # (all_of + map + reduce of one element) just to return the lone
+            # store's result unchanged.  Submitting directly is value- and
+            # timing-identical — all_of/map add no scheduling, only
+            # callback wrapping — so this is pure event-loop relief.
+            return stores[0].submit(map_fn, preload=preload)
         chains = [s.submit(map_fn, preload=preload) for s in stores]
 
         def reduce_all(results):

@@ -17,6 +17,7 @@ from ..primitives.keys import Ranges
 from ..primitives.route import Route
 from ..primitives.timestamp import Ballot, Timestamp, TxnId, TxnKind
 from ..primitives.txn import PartialTxn, Writes
+from ..protocol_batch.columns import ENGAGE_FLOOR
 from ..utils.invariants import Invariants, check_state
 from .cfk import InternalStatus, manages_execution
 from .command import Command, WaitingOn
@@ -44,6 +45,12 @@ def _observe_transition(safe_store: SafeCommandStore, command: Command) -> None:
     state (executeAt, deps, ballots, watermarks) at the transition — reads
     only; the recorder base class ignores them."""
     store = safe_store.store
+    if store.batch_engine is not None:
+        # the columnar mirror rides the SAME choke point: every SaveStatus
+        # change flows through here, so the struct-of-arrays row is fresh at
+        # every point a vectorized scan reads it (the exact-skip proofs in
+        # protocol_batch/engine.py depend on this)
+        store.batch_engine.note_transition(command)
     obs = store.observer()
     if obs is not None:
         obs.on_transition(store.node.id, store.id, command.txn_id,
@@ -408,7 +415,19 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
         min_fence = f if min_fence is None or f < min_fence else min_fence
     min_fence = min_fence if have_fence else None
     awaits_only = command.txn_id.awaits_only_deps
-    for dep_id in deps.txn_ids():
+    dep_ids = deps.txn_ids()
+    engine = safe_store.store.batch_engine
+    blocks_mask = decided_mask = None
+    if engine is not None and len(dep_ids) >= ENGAGE_FLOOR:
+        # the columnar frontier-init pass: one vectorized gather answers
+        # _still_blocks for every dep the mirror can decide (terminal rows,
+        # decided executeAt orderings); the rest fall through to the scalar
+        # predicate.  Dep states are stable across this loop (it mutates
+        # only the waiter and creates NOT_DEFINED stubs), so the mask
+        # computed up front stays valid.
+        blocks_mask, decided_mask = engine.still_blocks_mask(
+            dep_ids, execute_at, awaits_only)
+    for i, dep_id in enumerate(dep_ids):
         if dep_id == command.txn_id:
             continue
         if awaits_only and command.txn_id < dep_id:
@@ -446,7 +465,9 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
             # read of k58 missed op 181, txn epoch 5 executed at epoch 9).
             _note_elided_unless_applied(safe_store, command, dep_id)
             continue
-        if _still_blocks(safe_store, command, dep_id, execute_at):
+        if bool(blocks_mask[i]) if (decided_mask is not None
+                                    and decided_mask[i]) \
+                else _still_blocks(safe_store, command, dep_id, execute_at):
             waiting.add(dep_id)
             dep = safe_store.get_or_create(dep_id)
             dep.listeners.add(command.txn_id)
@@ -464,6 +485,8 @@ def initialise_waiting_on(safe_store: SafeCommandStore, command: Command) -> Non
     command.waiting_on = WaitingOn(waiting)
     # mirror the wait edges into the resolver's execution-frontier plane
     safe_store.store.resolver.register_waiting(command.txn_id, waiting)
+    if engine is not None:
+        engine.note_waiting(command)   # deps row pointer (frontier width)
     if deferred:
         safe_store.notify_listeners(command)
 
@@ -588,8 +611,14 @@ def _writes_cover_owned_footprint(store, footprint, written_keys) -> bool:
 def _written_routing_keys(writes):
     if writes is None:
         return None
-    return {k.to_routing() if hasattr(k, "to_routing") else k
-            for k in writes.keys}
+    # memoized on the (immutable) Writes object: the writes-cover check runs
+    # once per dep per waiter on the frontier-init/elision path, and
+    # rebuilding this set per call was a measured wall slice
+    rk = writes._rk
+    if rk is None:
+        rk = writes._rk = {k.to_routing() if hasattr(k, "to_routing") else k
+                           for k in writes.keys}
+    return rk
 
 
 def _dep_full_footprint(cmd):
@@ -699,6 +728,10 @@ def update_dependency_and_maybe_execute(safe_store: SafeCommandStore, waiter: Co
             _note_elided_unless_applied(safe_store, waiter, dep.txn_id)
         waiter.waiting_on.remove(dep.txn_id, applied)
         safe_store.store.resolver.remove_waiting(waiter.txn_id, dep.txn_id)
+        # (the columnar mirror's ``waiting`` column deliberately keeps the
+        # INIT-time frontier width — it is a layout/diagnostic plane, no
+        # decision reads it, and a per-edge refresh here was measured pure
+        # overhead on the release fan-out path)
         dep.listeners.discard(waiter.txn_id)
         maybe_execute(safe_store, waiter, always_notify_listeners=False)
 

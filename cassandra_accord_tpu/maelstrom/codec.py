@@ -21,7 +21,7 @@ import numpy as np
 _CLASSES: Dict[str, Tuple[Type, Tuple[str, ...]]] = {}
 # derived caches: never on the wire; rebuilt at decode (None for the lazy
 # ones, __wire_rebuild__ for the eager ones like Timestamp._k)
-_SKIP_SLOTS = {"_inverted", "_k", "_kind_c"}
+_SKIP_SLOTS = {"_inverted", "_k", "_kind_c", "_memo", "_h", "_tk", "_rk"}
 
 
 def _all_slots(cls: Type) -> Tuple[str, ...]:

@@ -457,13 +457,20 @@ class Deps:
     whose execution CommandsForKey does NOT manage, e.g. key sync points)
     — Deps.java:59-120."""
 
-    __slots__ = ("key_deps", "range_deps", "direct_key_deps")
+    __slots__ = ("key_deps", "range_deps", "direct_key_deps", "_memo")
 
     def __init__(self, key_deps: KeyDeps = None, range_deps: RangeDeps = None,
                  direct_key_deps: KeyDeps = None):
         self.key_deps = key_deps if key_deps is not None else KeyDeps.NONE
         self.range_deps = range_deps if range_deps is not None else RangeDeps.NONE
         self.direct_key_deps = direct_key_deps if direct_key_deps is not None else KeyDeps.NONE
+        # lazy derived-answer cache (never on the wire — codec _SKIP_SLOTS):
+        # Deps is immutable after construction, and the hot protocol scans
+        # (WaitingOn init, recovery evidence, the auditor) re-ask txn_ids()
+        # and participants() for the same object repeatedly — the re-derived
+        # sorted unions were a measured slice of per-commit wall cost.
+        # Cached values are shared: CALLERS MUST NOT MUTATE them.
+        self._memo = None
 
     NONE: "Deps"
 
@@ -475,10 +482,16 @@ class Deps:
         return len(self.txn_ids())
 
     def txn_ids(self) -> List[TxnId]:
-        out: Set[TxnId] = set(self.key_deps.txn_ids)
-        out.update(self.range_deps.txn_ids)
-        out.update(self.direct_key_deps.txn_ids)
-        return sorted(out)
+        memo = self._memo
+        if memo is None:
+            memo = self._memo = {}
+        cached = memo.get("txn_ids")
+        if cached is None:
+            out: Set[TxnId] = set(self.key_deps.txn_ids)
+            out.update(self.range_deps.txn_ids)
+            out.update(self.direct_key_deps.txn_ids)
+            cached = memo["txn_ids"] = sorted(out)
+        return cached
 
     def contains(self, txn_id: TxnId) -> bool:
         return (self.key_deps.contains(txn_id) or self.range_deps.contains(txn_id)
@@ -489,10 +502,18 @@ class Deps:
         return tids[-1] if tids else None
 
     def participants(self, txn_id: TxnId):
-        """Union footprint of a dependency (keys + ranges)."""
-        keys = self.key_deps.participants(txn_id).union(
-            self.direct_key_deps.participants(txn_id))
-        return keys, self.range_deps.participants(txn_id)
+        """Union footprint of a dependency (keys + ranges).  Memoized per
+        dep (immutable object, hot on the WaitingOn-init path); callers
+        treat the result as read-only."""
+        memo = self._memo
+        if memo is None:
+            memo = self._memo = {}
+        cached = memo.get(txn_id)
+        if cached is None:
+            keys = self.key_deps.participants(txn_id).union(
+                self.direct_key_deps.participants(txn_id))
+            cached = memo[txn_id] = (keys, self.range_deps.participants(txn_id))
+        return cached
 
     def slice(self, covering: Ranges) -> "Deps":
         return Deps(self.key_deps.slice(covering),

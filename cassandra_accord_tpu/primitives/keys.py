@@ -64,14 +64,21 @@ class IntKey(Key):
     """(prefix, value) integer key; prefix is the shard-space partition, matching the
     reference harness's PrefixedIntHashKey (BurnTest.java:278-286)."""
 
-    __slots__ = ("prefix", "value")
+    __slots__ = ("prefix", "value", "_tk")
 
     def __init__(self, value: int, prefix: int = 0):
         self.prefix = prefix
         self.value = value
+        # token tuple cache: ordering/hashing allocated a fresh tuple per
+        # compare, millions of times per burn (wire decode leaves this None
+        # — codec _SKIP_SLOTS — and it lazily rebuilds)
+        self._tk = (prefix, 0, value)
 
     def token(self) -> tuple:
-        return (self.prefix, 0, self.value)
+        tk = self._tk
+        if tk is None:
+            tk = self._tk = (self.prefix, 0, self.value)
+        return tk
 
     def __repr__(self) -> str:
         return f"{self.prefix}:{self.value}" if self.prefix else f"k{self.value}"

@@ -108,7 +108,7 @@ class TxnKind(enum.IntEnum):
 class Timestamp:
     """Totally-ordered HLC timestamp: (epoch, hlc, flags, node)."""
 
-    __slots__ = ("epoch", "hlc", "flags", "node", "_k")
+    __slots__ = ("epoch", "hlc", "flags", "node", "_k", "_h")
 
     def __init__(self, epoch: int, hlc: int, node: int, flags: int = 0):
         check_argument(0 <= epoch <= MAX_EPOCH, "epoch out of range: %s", epoch)
@@ -118,8 +118,12 @@ class Timestamp:
         self.hlc = hlc
         self.flags = flags
         self.node = node
-        # immutable; the comparison key is on every protocol hot path
+        # immutable; the comparison key is on every protocol hot path, and
+        # the hash rides every deps-set / listener-set / dict operation —
+        # both are precomputed once (timestamps hash millions of times per
+        # burn; re-hashing the tuple per call was a measured wall slice)
         self._k = (epoch, hlc, flags, node)
+        self._h = hash(self._k)
 
     # -- constants ----------------------------------------------------------
     NONE: "Timestamp"
@@ -153,7 +157,7 @@ class Timestamp:
         return isinstance(other, Timestamp) and self._k == other._k
 
     def __hash__(self) -> int:
-        return hash(self._k)
+        return self._h
 
     def compare_to(self, other: "Timestamp") -> int:
         a, b = self._k, other._k
@@ -163,6 +167,7 @@ class Timestamp:
         """Recompute derived caches after slot-wise decode (maelstrom codec
         skips them on the wire)."""
         self._k = (self.epoch, self.hlc, self.flags, self.node)
+        self._h = hash(self._k)
 
     # -- flags --------------------------------------------------------------
     @property

@@ -171,13 +171,16 @@ class PartialTxn(Txn):
 class Writes:
     """Applied write-set (Writes.java): (txnId, executeAt, keys, write)."""
 
-    __slots__ = ("txn_id", "execute_at", "keys", "write")
+    __slots__ = ("txn_id", "execute_at", "keys", "write", "_rk")
 
     def __init__(self, txn_id: TxnId, execute_at: Timestamp, keys, write):
         self.txn_id = txn_id
         self.execute_at = execute_at
         self.keys = keys
         self.write = write
+        # lazy routing-key-set cache (commands._written_routing_keys); never
+        # on the wire (codec _SKIP_SLOTS) — rebuilt on first use post-decode
+        self._rk = None
 
     def is_empty(self) -> bool:
         return self.write is None

@@ -1,0 +1,424 @@
+"""Columnar protocol engine (protocol_batch/): the exact-skip proof chain.
+
+The engine's contract is that ``columnar=on`` NEVER changes a protocol
+decision — every vectorized pass either answers a pure read bit-identically
+or skips scalar work it can prove is a no-op.  Proven here at three levels:
+
+1. end-to-end: same-seed hostile burn columnar on-vs-off is byte-identical
+   (full message trace + audit verdict + outcome partition) — extending the
+   PR 3/8/10 zero-observer-effect proof chain to the engine;
+2. per-pass property tests: the release skip mask and the frontier
+   still-blocks mask agree with the REAL scalar predicates over randomized
+   command states; the ragged ConsultBatch bridge round-trips empty /
+   duplicate / max-width rows against a scalar densify;
+3. the ramp smoke: protocol commits per SIM second strictly increases
+   across two in-flight levels (the ROADMAP item-1 scaling oracle, on the
+   deterministic sim plane so it can gate in tier-1).
+"""
+import numpy as np
+import pytest
+
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+from cassandra_accord_tpu.local.command import Command
+from cassandra_accord_tpu.local.commands import _still_blocks
+from cassandra_accord_tpu.local.status import SaveStatus
+from cassandra_accord_tpu.primitives.timestamp import (Domain, Timestamp,
+                                                       TxnId, TxnKind)
+from cassandra_accord_tpu.protocol_batch import (BatchEngine, TxnBatch,
+                                                 columnar_enabled,
+                                                 pack_order_lanes)
+from cassandra_accord_tpu.utils.random import RandomSource
+
+# concurrency 24 + few keys: deps lists and listener fan-outs cross the
+# engine's >=16 engagement floor, so the identity proof exercises the
+# vectorized passes for real (asserted below via the columnar_* counters)
+HOSTILE = dict(ops=60, concurrency=24, key_count=5, chaos=True,
+               allow_failures=True, durability=True, journal=True,
+               delayed_stores=True, clock_drift=True, audit="warn",
+               max_tasks=5_000_000)
+
+# tier-choice counters are wall-clock driven (excluded from the determinism
+# contract, as in reconcile); columnar_* exist only when the engine is on
+_EXCLUDED_STAT_PREFIXES = ("resolver_host_consults", "resolver_native_",
+                           "resolver_device_", "resolver_service_",
+                           "columnar_")
+
+
+def _comparable_stats(stats):
+    return {k: v for k, v in stats.items()
+            if not k.startswith(_EXCLUDED_STAT_PREFIXES)}
+
+
+# ---------------------------------------------------------------------------
+# 1. end-to-end byte-identity
+# ---------------------------------------------------------------------------
+
+def test_columnar_on_off_hostile_byte_identity():
+    """Same-seed hostile burn columnar on vs off: identical full message
+    traces, identical audit verdicts, identical outcome partitions — the
+    knob buys wall-clock, never trajectory."""
+    ta, tb = Trace(), Trace()
+    off = run_burn(11, tracer=ta.hook, columnar="off", **HOSTILE)
+    on = run_burn(11, tracer=tb.hook, columnar="on", **HOSTILE)
+    divergence = diff_traces(ta, tb)
+    assert divergence is None, \
+        f"columnar engine perturbed the simulation:\n{divergence}"
+    assert (off.ops_ok, off.ops_recovered, off.ops_nacked, off.ops_lost,
+            off.ops_failed, off.sim_micros) == \
+           (on.ops_ok, on.ops_recovered, on.ops_nacked, on.ops_lost,
+            on.ops_failed, on.sim_micros)
+    assert _comparable_stats(off.stats) == _comparable_stats(on.stats)
+    # audit verdicts identical (violations, SLO flags — the strict oracles
+    # would judge both runs the same)
+    assert off.audit is not None and on.audit is not None
+    assert off.audit == on.audit
+    # and the engine actually engaged (otherwise this test proves nothing)
+    assert on.stats.get("columnar_release_scans", 0) \
+        + on.stats.get("columnar_frontier_scans", 0) \
+        + on.stats.get("columnar_poll_scans", 0) > 0
+    assert "columnar_release_scans" not in off.stats
+
+
+def test_columnar_on_off_benign_byte_identity():
+    kw = dict(ops=60, concurrency=16, nodes=3, rf=3, key_count=4,
+              durability=True, journal=True)
+    ta, tb = Trace(), Trace()
+    off = run_burn(5, tracer=ta.hook, columnar="off", **kw)
+    on = run_burn(5, tracer=tb.hook, columnar="on", **kw)
+    assert diff_traces(ta, tb) is None
+    assert off.sim_micros == on.sim_micros
+    assert off.ops_ok == on.ops_ok
+
+
+# ---------------------------------------------------------------------------
+# 2. per-pass property tests
+# ---------------------------------------------------------------------------
+
+class _FakeStore:
+    """The slice of CommandStore the engine + _still_blocks read."""
+
+    def __init__(self):
+        self.cold = set()
+        self.commands = {}
+        self.batch_engine = None
+
+
+class _FakeSafe:
+    def __init__(self, store):
+        self.store = store
+
+    def get_if_exists(self, txn_id):
+        return self.store.commands.get(txn_id)
+
+
+def _tid(rng, kind=None):
+    kind = kind if kind is not None else rng.pick(
+        [TxnKind.READ, TxnKind.WRITE, TxnKind.WRITE,
+         TxnKind.EXCLUSIVE_SYNC_POINT])
+    return TxnId(1, 1000 + rng.next_int(100000), 1 + rng.next_int(5),
+                 kind, Domain.KEY)
+
+
+def _random_command(rng, txn_id):
+    """A Command in a random lifecycle state, mirrored like the live choke
+    point would have (every save_status change runs through the transition
+    hook with execute_at already settled)."""
+    cmd = Command(txn_id)
+    roll = rng.next_float()
+    if roll < 0.15:
+        pass                                   # NOT_DEFINED stub
+    elif roll < 0.3:
+        cmd.save_status = SaveStatus.PRE_ACCEPTED
+    elif roll < 0.45:
+        cmd.execute_at = Timestamp(1, 2000 + rng.next_int(100000),
+                                   1 + rng.next_int(5))
+        cmd.save_status = SaveStatus.COMMITTED
+    elif roll < 0.65:
+        cmd.execute_at = Timestamp(1, 2000 + rng.next_int(100000),
+                                   1 + rng.next_int(5))
+        cmd.save_status = SaveStatus.STABLE
+    elif roll < 0.8:
+        cmd.execute_at = Timestamp(1, 2000 + rng.next_int(100000),
+                                   1 + rng.next_int(5))
+        cmd.save_status = SaveStatus.PRE_APPLIED
+    elif roll < 0.9:
+        cmd.execute_at = Timestamp(1, 2000 + rng.next_int(100000),
+                                   1 + rng.next_int(5))
+        cmd.save_status = SaveStatus.APPLIED
+    else:
+        cmd.save_status = SaveStatus.INVALIDATED
+    return cmd
+
+
+def _engine_with(store):
+    engine = BatchEngine.__new__(BatchEngine)
+    engine.store = store
+    engine.batch = TxnBatch()
+    engine.stats = {k: 0 for k in
+                    ("release_scans", "release_skipped", "release_visited",
+                     "poll_scans", "poll_fast", "frontier_scans",
+                     "frontier_fast", "ingress_windows", "ingress_rows")}
+    engine._key_slots = {}
+    return engine
+
+
+def test_release_skip_mask_matches_scalar():
+    """Every waiter the mask skips is PROVABLY a scalar no-op: the real
+    ``_still_blocks`` answers True (still blocked) and the waiter is not
+    awaits-only (so ``_maybe_defer`` cannot mutate it)."""
+    rng = RandomSource(99)
+    for _trial in range(200):
+        store = _FakeStore()
+        safe = _FakeSafe(store)
+        engine = _engine_with(store)
+        dep = _random_command(rng, _tid(rng))
+        store.commands[dep.txn_id] = dep
+        engine.note_transition(dep)
+        waiters = []
+        for _ in range(12):
+            w = _random_command(rng, _tid(rng))
+            store.commands[w.txn_id] = w
+            engine.note_transition(w)
+            waiters.append(w.txn_id)
+        skip = engine.release_skip_mask(dep, waiters)
+        if skip is None:
+            continue
+        for i, wid in enumerate(waiters):
+            if not skip[i]:
+                continue
+            waiter = store.commands[wid]
+            assert not wid.kind.awaits_only_deps
+            assert waiter.execute_at is not None
+            # the scalar predicate must agree the waiter stays blocked
+            assert _still_blocks(safe, waiter, dep.txn_id,
+                                 waiter.execute_at) is True
+
+
+def test_still_blocks_mask_matches_scalar():
+    """Wherever the frontier mask claims a decided answer, the real scalar
+    ``_still_blocks`` answers identically."""
+    rng = RandomSource(7)
+    for _trial in range(200):
+        store = _FakeStore()
+        safe = _FakeSafe(store)
+        engine = _engine_with(store)
+        dep_ids = []
+        for _ in range(16):
+            d = _random_command(rng, _tid(rng))
+            if rng.next_float() < 0.8:
+                store.commands[d.txn_id] = d
+                engine.note_transition(d)
+            # else: unmirrored (cold/unwitnessed stand-in) — must be
+            # undecided by the mask
+            dep_ids.append(d.txn_id)
+        execute_at = Timestamp(1, 2000 + rng.next_int(100000), 1)
+        waiter = Command(_tid(rng, TxnKind.WRITE))
+        waiter.execute_at = execute_at
+        blocks, decided = engine.still_blocks_mask(dep_ids, execute_at,
+                                                   awaits_only=False)
+        for i, dep_id in enumerate(dep_ids):
+            if not decided[i]:
+                continue
+            assert bool(blocks[i]) == _still_blocks(safe, waiter, dep_id,
+                                                    execute_at)
+
+
+def test_settled_partition_matches_store():
+    rng = RandomSource(3)
+    store = _FakeStore()
+    engine = _engine_with(store)
+    ids = []
+    for _ in range(64):
+        cmd = _random_command(rng, _tid(rng))
+        if rng.next_float() < 0.7:
+            store.commands[cmd.txn_id] = cmd
+            engine.note_transition(cmd)
+        ids.append(cmd.txn_id)
+    done, outcome, resident = engine.settled_partition(ids)
+    for i, tid in enumerate(ids):
+        cmd = store.commands.get(tid)
+        if resident[i]:
+            assert cmd is not None
+            assert bool(done[i]) == (cmd.save_status.ordinal
+                                     >= SaveStatus.APPLIED.ordinal)
+            assert bool(outcome[i]) == (cmd.save_status.ordinal
+                                        >= SaveStatus.PRE_APPLIED.ordinal)
+        # non-resident rows carry no claims (scalar path handles them)
+
+
+def test_consult_batch_bridge_ragged_rows():
+    """Empty rows, duplicate columns, and max-width rows all round-trip the
+    TxnBatch -> ConsultBatch ingress bridge; the txn_rows attribution lanes
+    carry the canonical pack_lanes of each querying txn."""
+    batch = TxnBatch()
+    rng = RandomSource(21)
+    ids = [_tid(rng, TxnKind.WRITE) for _ in range(5)]
+    key_sets = [
+        (),                           # empty row (legal: width 0)
+        (3, 3, 3),                    # duplicate columns collapse in densify
+        tuple(range(16)),             # max-width row
+        (1,),
+        (2, 5),
+    ]
+    for tid, cols in zip(ids, key_sets):
+        batch.ensure(tid)
+        batch.set_keys(tid, cols)
+    before = [Timestamp(1, 50_000 + i, 1).pack_lanes()
+              for i in range(len(ids))]
+    kinds = [int(t.kind) for t in ids]
+    cb = batch.to_consult_batch(ids, before, kinds)
+    # pow2 bucket shape discipline (the jit-stability contract)
+    rows_bucket, flat_bucket = cb.shape_signature
+    assert rows_bucket & (rows_bucket - 1) == 0
+    assert flat_bucket & (flat_bucket - 1) == 0
+    assert cb.rows == len(ids)
+    # offsets describe exactly the ragged rows
+    widths = [cb.offsets[i + 1] - cb.offsets[i] for i in range(cb.rows)]
+    assert widths == [len(c) for c in key_sets]
+    # densify == scalar expectation (duplicates collapse to 1)
+    dense = cb.densify(k=16)
+    expect = np.zeros((len(ids), 16), dtype=np.int8)
+    for i, cols in enumerate(key_sets):
+        for c in cols:
+            expect[i, c] = 1
+    assert (dense == expect).all()
+    # txn_rows: the previously-reserved attribution lanes are populated
+    for i, tid in enumerate(ids):
+        assert tuple(int(v) for v in cb.txn_rows[i]) == tid.pack_lanes()
+    # padding rows are width-0 and carry zero txn lanes
+    for i in range(cb.rows, rows_bucket):
+        assert cb.offsets[i + 1] == cb.offsets[i]
+        assert not cb.txn_rows[i].any()
+
+
+def test_consult_ingress_from_query_specs():
+    """The engine packs a delivery window's resolver QuerySpecs into one
+    ragged ConsultBatch with querying-txn attribution — the ingress path the
+    delivery-window coalescing feeds."""
+    from cassandra_accord_tpu.impl.resolver import QuerySpec
+    from cassandra_accord_tpu.primitives.keys import IntKey
+    rng = RandomSource(13)
+    store = _FakeStore()
+    engine = _engine_with(store)
+    keys = [IntKey(i * 10).to_routing() for i in range(6)]
+    specs = []
+    for i in range(5):
+        by = _tid(rng, TxnKind.WRITE)
+        specs.append(QuerySpec("kc", by, keys[: 1 + i % 3],
+                               Timestamp(1, 90_000 + i, 1)))
+    cb = engine.consult_ingress(specs, engine.key_slot)
+    assert cb.rows == len(specs)
+    for i, spec in enumerate(specs):
+        lo, hi = int(cb.offsets[i]), int(cb.offsets[i + 1])
+        assert hi - lo == len(spec.keys)
+        assert tuple(int(v) for v in cb.txn_rows[i]) == spec.by.pack_lanes()
+    # key slots are stable across windows (first-witness order)
+    assert engine.key_slot(keys[0]) == 0
+
+
+def test_order_lanes_agree_with_timestamp_order():
+    rng = RandomSource(17)
+    ts = [Timestamp(1 + rng.next_int(3), rng.next_int(1 << 40),
+                    rng.next_int(32), flags=rng.next_int(4))
+          for _ in range(200)]
+    import numpy as _np
+    lanes = _np.array([pack_order_lanes(t) for t in ts], dtype=_np.int64)
+    from cassandra_accord_tpu.protocol_batch.columns import lanes_le, lanes_lt
+    bound = ts[0]
+    lt = lanes_lt(lanes, pack_order_lanes(bound))
+    le = lanes_le(lanes, pack_order_lanes(bound))
+    for i, t in enumerate(ts):
+        assert bool(lt[i]) == (t < bound)
+        assert bool(le[i]) == (t <= bound)
+
+
+def test_columnar_knob_resolution():
+    from dataclasses import replace
+
+    from cassandra_accord_tpu.config import LocalConfig
+    assert columnar_enabled(replace(LocalConfig(), columnar="auto"))
+    assert columnar_enabled(replace(LocalConfig(), columnar="on"))
+    assert not columnar_enabled(replace(LocalConfig(), columnar="off"))
+    with pytest.raises(ValueError):
+        columnar_enabled(replace(LocalConfig(), columnar="maybe"))
+
+
+def test_cfk_merged_walk_cache_consistency():
+    """The memoized cold+hot merged order always equals a fresh sort after
+    arbitrary mutation sequences (membership changes must invalidate)."""
+    from cassandra_accord_tpu.local.cfk import CommandsForKey, InternalStatus
+    from cassandra_accord_tpu.primitives.keys import IntKey
+    rng = RandomSource(31)
+    cfk = CommandsForKey(IntKey(1).to_routing())
+    known = []
+    for step in range(400):
+        roll = rng.next_float()
+        if roll < 0.5 or not known:
+            tid = _tid(rng, TxnKind.WRITE)
+            ea = Timestamp(1, tid.hlc + rng.next_int(50), tid.node)
+            cfk.update(tid, InternalStatus.PREACCEPTED)
+            known.append((tid, ea))
+        elif roll < 0.8:
+            tid, ea = known[rng.next_int(len(known))]
+            status = rng.pick([InternalStatus.COMMITTED, InternalStatus.STABLE,
+                               InternalStatus.APPLIED])
+            cfk.update(tid, status, ea)
+            if rng.next_float() < 0.5:
+                cfk.mark_durable(tid)
+        else:
+            tid, _ea = known[rng.next_int(len(known))]
+            cfk.prune_applied_before(tid)
+        if step % 10 == 0:
+            # force the merged walk (sync-point query: flag_elision False)
+            seen = []
+            cfk.map_reduce_active(Timestamp.MAX, lambda _t: True, seen.append,
+                                  flag_elision=False)
+            if cfk._merged_cache is not None:
+                fresh = sorted(list(cfk.cold.values()) + cfk.by_id)
+                assert [e.txn_id for e in cfk._merged_cache] \
+                    == [e.txn_id for e in fresh]
+
+
+def test_deps_memo_roundtrip():
+    """The Deps lazy memo (txn_ids/participants) returns stable answers and
+    survives the wire codec (the _memo slot never hits the wire)."""
+    from cassandra_accord_tpu.maelstrom.codec import (_register_all,
+                                                      decode_value,
+                                                      encode_value)
+    from cassandra_accord_tpu.primitives.deps import DepsBuilder
+    from cassandra_accord_tpu.primitives.keys import IntKey
+    _register_all()
+    rng = RandomSource(41)
+    b = DepsBuilder()
+    tids = [_tid(rng, TxnKind.WRITE) for _ in range(8)]
+    for i, tid in enumerate(tids):
+        b.add(IntKey(i % 3).to_routing(), tid)
+    deps = b.build()
+    first = deps.txn_ids()
+    assert deps.txn_ids() is first          # memoized
+    keys0, rngs0 = deps.participants(tids[0])
+    assert deps.participants(tids[0]) == (keys0, rngs0)
+    back = decode_value(encode_value(deps))
+    assert back.txn_ids() == first          # recomputed post-decode, equal
+
+
+# ---------------------------------------------------------------------------
+# 3. the concurrency-ramp smoke (deterministic sim plane)
+# ---------------------------------------------------------------------------
+
+def test_protocol_ramp_sim_rate_increases():
+    """Commits per SIM second strictly increases across two in-flight
+    levels — the protocol-level scaling oracle (ROADMAP item 1: the rate
+    must scale with concurrency, not flatline).  Sim-time, so deterministic:
+    no wall-clock flake."""
+    kw = dict(ops=120, concurrency=None, nodes=3, rf=3, key_count=6,
+              durability=True, journal=True)
+    rates = []
+    for conc in (4, 24):
+        kw["concurrency"] = conc
+        res = run_burn(seed=7, **kw)
+        assert res.ops_ok == 120
+        rates.append(res.ops_ok / (res.sim_micros / 1e6))
+    assert rates[1] > rates[0], \
+        f"protocol commits/s flatlined across the ramp: {rates}"

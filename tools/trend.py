@@ -46,6 +46,12 @@ DEFAULT_HISTORY_PATH = os.path.join(_REPO_ROOT, HISTORY_NAME)
 TREND_SIM_KEYS = ("commit_latency_mean_us", "commit_latency_p95_us",
                   "sim_ms", "messages")
 
+# the protocol-throughput series (bench.py protocol_ramp): wall commits/s at
+# the top concurrency level with the columnar engine on — the ledger line
+# that shows the 43-commits/s wall breaking run-over-run.  Wall-clock, so
+# machine-dependent: rendered as its own series, never gated.
+RAMP_KEY = "protocol_commits_per_sec"
+
 
 def history_path(path: Optional[str] = None) -> Optional[str]:
     """Resolve the ledger path: explicit arg > ACCORD_BENCH_HISTORY env >
@@ -150,6 +156,12 @@ def trend_lines(entries: List[dict], last_k: int = 8,
         metric = e.get("metric")
         if metric and e.get("value") is not None:
             head += f" {metric}={e['value']}"
+        if e.get(RAMP_KEY) is not None and metric != RAMP_KEY:
+            head += f" {RAMP_KEY}={e[RAMP_KEY]}"
+        ramp = e.get("ramp")
+        if isinstance(ramp, dict) and ramp.get("wall"):
+            head += (f"  ramp@{ramp.get('levels')}: "
+                     f"wall={ramp['wall']} sim={ramp.get('sim')}")
         sims = [f"{k}={_sim_value(e, k)}" for k in sim_keys
                 if _sim_value(e, k) is not None]
         if sims:
@@ -176,6 +188,29 @@ def trend_lines(entries: List[dict], last_k: int = 8,
             prev = v
         tail = f"  [{skipped} other-seed run(s) omitted]" if skipped else ""
         lines.append(f"  {key:<26} " + " -> ".join(parts) + tail)
+    # the protocol-throughput series: delta arrows across runs recording the
+    # same ramp levels (a different concurrency ceiling is a different
+    # measurement, like a different seed cohort)
+    ramp_present = [(e, e[RAMP_KEY]) for e in window
+                    if e.get(RAMP_KEY) is not None]
+    if len(ramp_present) >= 1:
+        def _levels(e):
+            ramp = e.get("ramp")
+            lv = ramp.get("levels") if isinstance(ramp, dict) else None
+            return tuple(lv) if isinstance(lv, list) else None
+        cohort = _levels(ramp_present[-1][0])
+        same = [v for e, v in ramp_present if _levels(e) == cohort]
+        if len(same) >= 2:
+            parts = []
+            prev = None
+            for v in same:
+                parts.append(f"{v}{_fmt_delta(v, prev)}")
+                prev = v
+            lines.append(f"  {RAMP_KEY:<26} " + " -> ".join(parts)
+                         + "  (wall-clock: never gated)")
+        else:
+            lines.append(f"  {RAMP_KEY:<26} {same[-1]} (no prior same-levels "
+                         f"run to compare)")
     return lines
 
 
@@ -224,6 +259,7 @@ def main(argv=None) -> int:
             "metric": latest.get("metric"), "value": latest.get("value"),
             "sim": {k: _sim_value(latest, k) for k in TREND_SIM_KEYS
                     if _sim_value(latest, k) is not None} or None,
+            RAMP_KEY: latest.get(RAMP_KEY),
         },
         "deltas_vs_prev": latest_deltas(entries),
     }
