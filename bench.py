@@ -409,6 +409,109 @@ def bench_graph(t=8192, iters=3):
     return out
 
 
+def bench_deps_graph(ts=(1024, 8192), dense_max=None):
+    """ISSUE-13 ``deps_graph`` stage: the O(T^3)-shaped dense kernels vs
+    their frontier/CSR replacements (ops.frontier_kernels) on the BASELINE
+    config-5 graph shape (~8 deps/txn, cycle-heavy), at T in {1k, 8k}.
+
+    The dense twins run at every T up to ``dense_max`` (default: 1024, env
+    ACCORD_BENCH_DENSE_MAX overrides) — at 8k they are the 45.5 s / 41.8 s
+    kernels this stage exists to retire, so by default their 8k cost is
+    reported as null and the speedup at 1k stands in; set
+    ACCORD_BENCH_DENSE_MAX=8192 for the full old-vs-new measurement.
+    Bit-identity old-vs-new is asserted in tier-1 (test_ops_kernels); this
+    stage measures rates only."""
+    import jax
+    import jax.numpy as jnp
+    from cassandra_accord_tpu.ops import deps_kernels as dk
+    from cassandra_accord_tpu.ops import frontier_kernels as fk
+    if dense_max is None:
+        dense_max = int(os.environ.get("ACCORD_BENCH_DENSE_MAX", "1024"))
+    out = {}
+    for t in ts:
+        rng = np.random.default_rng(9)
+        adj = (rng.random((t, t)) < (8.0 / t)).astype(np.int8)
+        np.fill_diagonal(adj, 0)
+        status = np.full((t,), 4, dtype=np.int8)                 # STABLE
+        active = np.ones((t,), dtype=bool)
+        row = {"T": t, "edges": int(adj.sum())}
+
+        def timed(fn, warm=True):
+            if warm:
+                fn()
+            t0 = time.perf_counter()
+            r = fn()
+            jax.block_until_ready(r) if hasattr(r, "block_until_ready") \
+                else r
+            return round(time.perf_counter() - t0, 4)
+
+        row["closure_frontier_s"] = timed(
+            lambda: fk.closure_condensed(adj))
+        row["elide_frontier_s"] = timed(lambda: fk.elide_csr(adj))
+        row["scc_frontier_s"] = timed(
+            lambda: fk.scc_condense_csr(adj, active))
+        row["kahn_frontier_s"] = timed(
+            lambda: fk.kahn_frontier_csr(adj, status, active))
+        if t <= dense_max:
+            a = jnp.asarray(adj)
+            act = jnp.asarray(active)
+            st_j = jnp.asarray(status)
+            row["closure_dense_s"] = timed(
+                lambda: jax.block_until_ready(dk.transitive_closure(a)))
+            row["elide_dense_s"] = timed(
+                lambda: jax.block_until_ready(dk.elide(a)))
+            row["scc_dense_s"] = timed(
+                lambda: jax.block_until_ready(dk.scc_condense(a, act)[0]))
+            row["kahn_dense_s"] = timed(
+                lambda: jax.block_until_ready(dk.kahn_frontier(a, st_j, act)))
+            for k2 in ("closure", "elide", "scc", "kahn"):
+                new, old = row[f"{k2}_frontier_s"], row[f"{k2}_dense_s"]
+                row[f"{k2}_speedup"] = round(old / new, 2) if new else None
+        else:
+            row["dense_skipped"] = f"T > dense_max={dense_max} " \
+                                   f"(ACCORD_BENCH_DENSE_MAX overrides)"
+        out[f"T{t}"] = row
+    # frontier-DRIVEN vs event-driven end-to-end commit rate, same workload
+    from cassandra_accord_tpu.harness.burn import run_burn
+    rates = {}
+    for label, fx in (("event_driven", False), ("frontier_driven", True)):
+        t0 = time.perf_counter()
+        res = run_burn(seed=PROTO_SEED, ops=300, concurrency=PROTO_CONC,
+                       resolver="tpu", batch_window_us=TPU_WINDOW_US,
+                       frontier_exec=fx, **PROTO_KW)
+        dt = time.perf_counter() - t0
+        rates[label] = {"commits_per_sec_wall": round(res.ops_ok / dt, 1),
+                        "sim_ms": round(res.sim_micros / 1000.0, 1),
+                        "frontier_released":
+                            res.stats.get("frontier_released", 0)}
+    out["exec_commit_rate"] = rates
+    # the KNOWN_ISSUES round-6 repro config, profiled: the deps_execute_wait
+    # phase share the 72.8% figure was measured on (round 12 split it into
+    # commit-plane vs execute-plane waits; the ledger holds the series)
+    from cassandra_accord_tpu.observe import FlightRecorder
+    rec = FlightRecorder()
+    res = run_burn(0, ops=100, concurrency=20, resolver="verify",
+                   frontier_exec=True, chaos=True, allow_failures=True,
+                   topology_churn=True, durability=True, journal=True,
+                   delayed_stores=True, clock_drift=True, cache_miss=True,
+                   observer=rec, max_tasks=200_000_000)
+    b = rec.latency_budget()
+    out["frontier_profile"] = {
+        "workload": "round-6 repro (seed 0, 100 ops, full hostile matrix, "
+                    "frontier_exec)",
+        "ops": res.resolved,
+        "mean_commit_ms": round(b["mean_commit_latency_us"] / 1000.0, 1),
+        "deps_execute_wait_share":
+            round((b["phases"].get("deps_execute_wait") or {})
+                  .get("share", 0.0), 4),
+        "deps_commit_wait_share":
+            round((b["phases"].get("deps_commit_wait") or {})
+                  .get("share", 0.0), 4),
+        "attributed_share": b["attributed_share"],
+    }
+    return out
+
+
 def probe_device(timeout_s: int = 120) -> bool:
     """Check the TPU is actually reachable — in a SUBPROCESS, because a wedged
     axon tunnel blocks inside native code at jax import (uninterruptible
@@ -521,6 +624,34 @@ def emit_and_exit(code=0):
                 "incomplete": RESULT["detail"].get("incomplete", True),
                 "sim": smoke.get("sim"),
             }
+            dg = RESULT["detail"].get("deps_graph")
+            if dg:
+                # the kernel series tools/trend.py renders: frontier-tier
+                # seconds per kernel per T + the old-vs-new speedups where
+                # the dense twin was measured, plus the execution-mode rates
+                deps_graph = {}
+                for tkey, row in dg.items():
+                    if not tkey.startswith("T"):
+                        continue
+                    deps_graph[tkey] = {
+                        k2: row.get(k2) for k2 in
+                        ("closure_frontier_s", "elide_frontier_s",
+                         "scc_frontier_s", "kahn_frontier_s",
+                         "closure_speedup", "elide_speedup", "scc_speedup",
+                         "kahn_speedup")
+                        if row.get(k2) is not None}
+                exec_rate = dg.get("exec_commit_rate") or {}
+                if exec_rate:
+                    deps_graph["exec_commit_rate"] = {
+                        label: (v or {}).get("commits_per_sec_wall")
+                        for label, v in exec_rate.items()}
+                prof = dg.get("frontier_profile") or {}
+                if prof:
+                    deps_graph["frontier_deps_execute_wait_share"] = \
+                        prof.get("deps_execute_wait_share")
+                    deps_graph["frontier_deps_commit_wait_share"] = \
+                        prof.get("deps_commit_wait_share")
+                record["deps_graph"] = deps_graph
             ramp = RESULT["detail"].get("protocol_ramp")
             if ramp:
                 # the ledger's protocol_commits_per_sec series
@@ -786,6 +917,10 @@ def main():
                                if "frontier" in k or "exec" in k},
         }
     stage("frontier_exec", frontier)
+
+    dg = stage("deps_graph", bench_deps_graph)   # ISSUE-13 kernel series
+    if dg is not None:
+        d["deps_graph"] = dg
 
     def record():
         from cassandra_accord_tpu.harness.consult_trace import record_burn

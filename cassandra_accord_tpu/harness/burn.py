@@ -379,7 +379,22 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                             return
                         r = getattr(cs.resolver, "tpu", cs.resolver)
                         ready = r.frontier_ready()
-                        for tid in list(cs.exec_deferred):
+                        parked = list(cs.exec_deferred)
+                        # columnar prefilter (exact-skip): resident rows the
+                        # mirror PROVES moved past STABLE are discarded
+                        # without the scalar visit; unknown rows (possible
+                        # fault-in) always take it
+                        known = stable = None
+                        if cs.batch_engine is not None:
+                            part = cs.batch_engine.exec_deferred_partition(
+                                parked)
+                            if part is not None:
+                                known, stable = part
+                        for i, tid in enumerate(parked):
+                            if known is not None and known[i] \
+                                    and not stable[i]:
+                                cs.exec_deferred.discard(tid)
+                                continue
                             cmd = safe.get_if_exists(tid)
                             if cmd is None \
                                     or cmd.save_status is not _SS.STABLE:

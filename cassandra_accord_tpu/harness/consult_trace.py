@@ -153,6 +153,9 @@ class _RecordingResolver(DepsResolver):
     def remove_waiting(self, waiter, dep) -> None:
         self.inner.remove_waiting(waiter, dep)
 
+    def note_terminal(self, txn_id, invalidated: bool = False) -> None:
+        self.inner.note_terminal(txn_id, invalidated=invalidated)
+
     # -- queries -------------------------------------------------------------
     def key_conflicts(self, by, keys, before):
         self._probe_durable()

@@ -108,6 +108,18 @@ class DepsResolver:
         """An edge drained (dep applied/invalidated/truncated or provably
         ordered after the waiter — Commands.java:704-775)."""
 
+    def note_terminal(self, txn_id: TxnId, invalidated: bool = False) -> None:
+        """The host command reached a TERMINAL SaveStatus (applied /
+        invalidated / truncated / erased) — regardless of whether any cfk
+        accepted a witness update for the transition.  ``register`` alone
+        cannot carry this: it is gated behind the cfk key-indexing loop,
+        which refuses demoted-cold and pruned entries, truncation never
+        re-registers at all, and topology churn can drop key ownership
+        between STABLE and APPLIED.  Device resolvers must still advance
+        their mirror's status (a stale STABLE row reports the txn
+        execution-ready forever — the one-sided device mirror leak) and drop
+        the txn's wait edges; host resolvers keep no mirror and ignore this."""
+
     def mark_durable(self, txn_id: TxnId) -> None:
         """Per-txn UNIVERSAL durability (Commands.set_durability crossing
         UNIVERSAL — the coordinator saw every Apply ack): device-plane
@@ -248,6 +260,9 @@ class VerifyDepsResolver(DepsResolver):
 
     def remove_waiting(self, waiter, dep) -> None:
         self.tpu.remove_waiting(waiter, dep)
+
+    def note_terminal(self, txn_id, invalidated: bool = False) -> None:
+        self.tpu.note_terminal(txn_id, invalidated=invalidated)
 
     def is_indexed(self, txn_id) -> bool:
         return self.tpu.is_indexed(txn_id)

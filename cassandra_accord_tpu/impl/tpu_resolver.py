@@ -115,6 +115,17 @@ def _status_codes() -> Tuple[int, int]:
     return _COMMITTED, _INVALIDATED
 
 
+_APPLIED: Optional[int] = None
+
+
+def _applied_code() -> int:
+    global _APPLIED
+    if _APPLIED is None:
+        from ..local.cfk import InternalStatus
+        _APPLIED = int(InternalStatus.APPLIED)
+    return _APPLIED
+
+
 def _pack_before(before: Timestamp) -> Tuple[int, int, int, int, int]:
     """Pack a query bound, saturating out-of-device-range bounds (e.g. the
     ephemeral-read Timestamp.MAX sentinel) to lanes above every real packed
@@ -778,24 +789,60 @@ class TpuDepsResolver(DepsResolver):
         if s is not None:
             s.discard(dep)
 
+    def note_terminal(self, txn_id: TxnId, invalidated: bool = False) -> None:
+        """Terminal-transition mirror update, DECOUPLED from key indexing
+        (see DepsResolver.note_terminal).  The live witness path misses
+        terminal transitions in three shapes — cfk refuses demoted-cold /
+        pruned entries, truncation never calls register_witness, and GC's
+        physical erase deletes the command outright — each of which left the
+        mirror status at STABLE so the kernel frontier reported the slot
+        ready forever (the KNOWN_ISSUES device-only parity violation).
+
+        Only frontier-relevant state moves: the status code and the txn's
+        own wait edges.  Deps-plane answers are untouched — APPLIED has the
+        same join eligibility as STABLE, and INVALIDATED is gated by
+        cfk.update's committed-never-invalidated rule exactly like
+        ``register`` (it only fires where the cfk walk also excludes the
+        entry: never-committed, or already demoted/pruned out of the hot
+        set), so cpu/tpu query parity is preserved."""
+        self.edges.pop(txn_id, None)   # a terminal txn is no longer a waiter
+        m = self.txns.get(txn_id)
+        if m is None:
+            return
+        committed_i, invalidated_i = _status_codes()
+        if invalidated:
+            if m.status < committed_i:
+                m.status = invalidated_i
+                self._dirty_txns.add(txn_id)
+                # eligibility changed mid-window: cached prefetch answers
+                # predate it (rare — only cfk-refused invalidations land here)
+                self._cache = None
+        else:
+            applied_i = _applied_code()
+            if m.status < applied_i:
+                m.status = applied_i
+                self._dirty_txns.add(txn_id)
+
     def frontier_ready(self) -> Set[TxnId]:
         """The execution frontier as ONE kernel pass
-        (ops.deps_kernels.kahn_frontier over the mirrored wait graph): every
-        indexed STABLE txn whose remaining wait edges all point at
-        done/evicted slots.  Edges to txns outside the index (range txns,
-        cross-epoch deps) conservatively block their waiter.  This is the
-        batch-executor view of the same frontier the event-driven WaitingOn
-        drains one notification at a time (Commands.java:617-775); the burn
-        harness asserts the two agree at quiescent points."""
-        import jax.numpy as jnp
-        from ..ops import deps_kernels as dk
+        (ops.frontier_kernels.kahn_frontier_edges over the mirrored wait
+        graph): every indexed STABLE txn whose remaining wait edges all
+        point at done/evicted slots.  Edges to txns outside the index (range
+        txns, cross-epoch deps) conservatively block their waiter.  This is
+        the batch-executor view of the same frontier the event-driven
+        WaitingOn drains one notification at a time (Commands.java:617-775);
+        the burn harness asserts the two agree at quiescent points.
+
+        The wait graph is COMPACTED to the slots that participate in edges
+        and handed to the frontier tier as CSR edge arrays — the previous
+        dense formulation materialized a pow2 [n, n] adjacency per release
+        tick and ran a matmul over it, quadratic in the involved set for a
+        graph that is sparse by construction (elision bounds deps to
+        concurrency)."""
+        from ..ops import frontier_kernels as fk
         self._flush()
         h = self._h
         stable_i = 4   # ops.graph_state.STABLE == cfk.InternalStatus.STABLE
-        # COMPACT the wait graph: the dense kernel runs over only the slots
-        # that participate in edges (waiters + their indexed deps) — dense
-        # [T, T] would be quadratic in index capacity for a graph that is
-        # sparse by construction (elision bounds deps to concurrency)
         involved: List[int] = []
         pos: Dict[int, int] = {}
 
@@ -832,18 +879,13 @@ class TpuDepsResolver(DepsResolver):
             if s not in waiting_slots and s in self.txn_at:
                 ready_ids.add(self.txn_at[s])
         if involved:
-            n = len(involved)
-            n_pad = 1 << max(3, (n - 1).bit_length())   # pow2 jit buckets
-            adj = np.zeros((n_pad, n_pad), dtype=np.int8)
-            for a, b in edge_pairs:
-                adj[pos[a], pos[b]] = 1
             idx = np.asarray(involved)
-            status = np.zeros((n_pad,), dtype=h["status"].dtype)
-            active = np.zeros((n_pad,), dtype=np.bool_)   # pad rows inactive
-            status[:n] = h["status"][idx]
-            active[:n] = h["active"][idx]
-            ready = np.asarray(dk.kahn_frontier(
-                jnp.asarray(adj), jnp.asarray(status), jnp.asarray(active)))
+            src = np.fromiter((pos[a] for a, _ in edge_pairs),
+                              dtype=np.int32, count=len(edge_pairs))
+            dst = np.fromiter((pos[b] for _, b in edge_pairs),
+                              dtype=np.int32, count=len(edge_pairs))
+            ready = fk.frontier_ready_from_edges(
+                src, dst, h["status"][idx], h["active"][idx])
             for i in np.nonzero(ready)[0]:
                 s = involved[int(i)]
                 if s not in external_waiters and s in self.txn_at:

@@ -738,6 +738,11 @@ class SafeCommandStore:
                     store.transient_listeners.pop(txn_id, None)
                     if store.batch_engine is not None:
                         store.batch_engine.drop(txn_id)
+                    # the physical drop bypasses every transition choke point:
+                    # tell the frontier mirror directly, or its slot keeps the
+                    # last-registered status (STABLE rows then sit in the
+                    # kernel frontier as ready forever — the mirror leak)
+                    store.resolver.note_terminal(txn_id)
                     if store.journal is not None:
                         store.journal.erase(store, txn_id)
                     continue

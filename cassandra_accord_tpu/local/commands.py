@@ -51,6 +51,16 @@ def _observe_transition(safe_store: SafeCommandStore, command: Command) -> None:
         # every point a vectorized scan reads it (the exact-skip proofs in
         # protocol_batch/engine.py depend on this)
         store.batch_engine.note_transition(command)
+    ss = command.save_status
+    if ss.is_terminal:
+        # terminal transitions reach the resolver's frontier mirror HERE, not
+        # through register_witness: the witness path is gated behind cfk key
+        # indexing, which refuses demoted-cold/pruned entries (and truncation
+        # never re-registers at all) — the mirror then kept a stale STABLE
+        # status and the kernel frontier reported the txn ready forever (the
+        # one-sided device mirror leak, KNOWN_ISSUES round 6-11)
+        store.resolver.note_terminal(
+            command.txn_id, invalidated=ss is SaveStatus.INVALIDATED)
     obs = store.observer()
     if obs is not None:
         obs.on_transition(store.node.id, store.id, command.txn_id,

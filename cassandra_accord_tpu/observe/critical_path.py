@@ -176,7 +176,10 @@ def extract_txn_path(span, preaccept_recv_us: Optional[int] = None) \
             # decided (bootstrap / fetch / propagate): its execute wait is
             # fence/bootstrap-class, not deps-class
             apply_chains.append((applied, _first(transitions, _EXEC_READY),
-                                 st, pa is None))
+                                 st, pa is None,
+                                 _first(transitions, ("PRE_APPLIED",)),
+                                 _first(transitions, ("READY_TO_EXECUTE",
+                                                      "APPLYING"))))
     if not preaccept_ts and not apply_chains:
         # no replica evidence at all (e.g. probe-resolved after total loss):
         # recovery if probed, else unattributed
@@ -220,15 +223,36 @@ def extract_txn_path(span, preaccept_recv_us: Optional[int] = None) \
     emit("stable_propagation", "message_wait",
          min(stable_ts) if stable_ts else None)
     # 5) deps/execute wait + apply on the CRITICAL store: the one whose
-    #    APPLIED lands last (the client ack waits for it)
+    #    APPLIED lands last (the client ack waits for it).  The wait splits
+    #    by WHICH plane was pending (round 12, so frontier-driven and
+    #    event-driven runs compare class-for-class in one report):
+    #    - deps_commit_wait   — the txn's own OUTCOME had not arrived (no
+    #                           PRE_APPLIED yet): nothing local can apply it
+    #                           regardless of deps;
+    #    - deps_execute_wait  — outcome known, waiting for the local
+    #                           dependency frontier to drain (and, in
+    #                           frontier mode, for the device release tick).
+    #    Both phases keep the deps_wait / fence_bootstrap_wait CLASS; the
+    #    split is the phase axis.
     if apply_chains:
         # key on the APPLIED time only: the tuples carry Optionals that do
         # not order; ties break on list order (deterministic insertion order)
-        applied, exec_ready, _stable, bootstrapped = \
+        applied, exec_ready, _stable, bootstrapped, outcome_at, drained_at = \
             max(apply_chains, key=lambda c: c[0])
         wait_cls = "fence_bootstrap_wait" if bootstrapped else "deps_wait"
         if exec_ready is not None:
-            emit("deps_execute_wait", wait_cls, exec_ready)
+            if outcome_at is not None and (drained_at is None
+                                           or outcome_at < drained_at):
+                # outcome arrived first: until then the commit/outcome plane
+                # was the (or a) binding constraint
+                emit("deps_commit_wait", wait_cls, outcome_at)
+            emit("deps_execute_wait", wait_cls,
+                 drained_at if drained_at is not None else applied)
+            if outcome_at is not None and drained_at is not None \
+                    and outcome_at > drained_at:
+                # frontier drained before the outcome landed: that tail is
+                # outcome wait, not apply compute
+                emit("deps_commit_wait", wait_cls, outcome_at)
             emit("apply", "handler_compute", applied)
         else:
             emit("deps_execute_wait", wait_cls, applied)

@@ -7,16 +7,26 @@ global JAX-config side effects.
 """
 from .graph_state import (
     GraphState, init_state, insert_batch, set_status_batch,
-    set_execute_at_batch, evict_mask, ts_less, to_host_deps, TS_LANES,
+    set_execute_at_batch, evict_mask, ts_less, to_host_deps, adj_edges,
+    TS_LANES,
 )
 from .deps_kernels import (
     overlap_join, max_conflict_ts, transitive_closure, elide,
     kahn_frontier, kahn_levels, scc_condense,
 )
+from .frontier_kernels import (
+    edges_from_dense, kahn_frontier_csr, kahn_levels_csr, scc_condense_csr,
+    transitive_closure_csr, elide_csr, closure_condensed,
+    frontier_ready_from_edges,
+)
 
 __all__ = [
     "GraphState", "init_state", "insert_batch", "set_status_batch",
-    "set_execute_at_batch", "evict_mask", "ts_less", "to_host_deps", "TS_LANES",
+    "set_execute_at_batch", "evict_mask", "ts_less", "to_host_deps",
+    "adj_edges", "TS_LANES",
     "overlap_join", "max_conflict_ts", "transitive_closure", "elide",
     "kahn_frontier", "kahn_levels", "scc_condense",
+    "edges_from_dense", "kahn_frontier_csr", "kahn_levels_csr",
+    "scc_condense_csr", "transitive_closure_csr", "elide_csr",
+    "closure_condensed", "frontier_ready_from_edges",
 ]

@@ -1,0 +1,425 @@
+"""Frontier-tier dependency-graph kernels: CSR edge lists + wavefront relaxation.
+
+The dense kernels in ``deps_kernels.py`` answer closure / elision / SCC
+questions by repeated [T, T] bool-matmul powering — O(T^3 log T) work no
+matter how sparse the graph is.  At T = 8192 on the CPU backend that is
+45.5 s (``transitive_closure``) and 41.8 s (``scc_condense``) for a graph
+whose Kahn frontier the same machine answers in 0.15 s, because elision
+bounds real deps graphs to ~concurrency edges per txn: the work is
+proportional to T^2-per-iteration, the information is proportional to E.
+
+This module is the frontier-shaped replacement (PAPERS: Tascade's
+atomic-free asynchronous reduction trees — every per-round combine below is
+a one-pass segment scatter-reduce over the edge list, no atomics, no
+ordering sensitivity; DPU-v2's irregular-DAG execution — the edge list IS
+the schedule).  Everything decision-bearing is computed in one of two
+shapes:
+
+- **jitted wavefront relaxation** (``lax.while_loop`` over static-shape
+  [E]/[T] arrays, bounded iteration): trimming, min-label SCC coloring,
+  backward root-reach, Kahn level peeling, the execution frontier.  Work per
+  round is O(E) segment ops; rounds are bounded by graph depth (level
+  peeling), SCC diameter (label flood), or SCC count (outer extraction) —
+  never by T^2.
+- **level-synchronous packed-bitset DP** (host numpy): reachability over the
+  *condensation* (always a DAG) as uint8-packed rows combined dep-first in
+  topological waves — O(E_cond * C/8) byte ops instead of log T dense
+  matmuls.
+
+The dense kernels REMAIN in-tree as the bit-identity cross-check tier, the
+way ``consult`` keeps its host fallback: every public function here is
+asserted equal to its dense twin on randomized graphs (cycles included) by
+tests/test_ops_kernels.py, and bench.py's ``deps_graph`` stage measures both
+tiers side by side.
+
+Edge convention matches ``GraphState.adj``: an edge (i, j) means txn i
+depends on (must execute after) txn j; ``src`` holds i, ``dst`` holds j.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .graph_state import STABLE, APPLIED, INVALIDATED
+
+
+def edges_from_dense(adj) -> Tuple[np.ndarray, np.ndarray]:
+    """Dense [T, T] adjacency -> (src, dst) int32 edge lists (host)."""
+    src, dst = np.nonzero(np.asarray(adj))
+    return src.astype(np.int32), dst.astype(np.int32)
+
+
+def _pow2(n: int, floor: int = 8) -> int:
+    return 1 << max(floor.bit_length() - 1, (max(1, n) - 1).bit_length())
+
+
+def _pad_edges(src: np.ndarray, dst: np.ndarray,
+               e_pad: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad edge lists to a pow2 jit bucket with a validity mask; padding
+    edges point at slot 0 and are masked out of every reduction."""
+    e = len(src)
+    valid = np.zeros((e_pad,), dtype=bool)
+    valid[:e] = True
+    s = np.zeros((e_pad,), dtype=np.int32)
+    d = np.zeros((e_pad,), dtype=np.int32)
+    s[:e] = src
+    d[:e] = dst
+    return s, d, valid
+
+
+# ---------------------------------------------------------------------------
+# Execution frontier (the command-store release path)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def kahn_frontier_edges(src: jax.Array, dst: jax.Array, evalid: jax.Array,
+                        status: jax.Array, active: jax.Array) -> jax.Array:
+    """``deps_kernels.kahn_frontier`` over an edge list: one edge-parallel
+    pass instead of a [T, T] matmul.  Returns [T] bool."""
+    dep_done = (status == APPLIED) | (status == INVALIDATED) | ~active
+    contrib = (evalid & ~dep_done[dst]).astype(jnp.int32)
+    waiting = jnp.zeros(status.shape, jnp.int32).at[src].max(
+        contrib, mode="drop") > 0
+    return active & (status == STABLE) & ~waiting
+
+
+@jax.jit
+def kahn_levels_edges(src: jax.Array, dst: jax.Array, evalid: jax.Array,
+                      active: jax.Array) -> jax.Array:
+    """``deps_kernels.kahn_levels`` over an edge list: identical round
+    structure (peel the zero-blocked wave, one pass per level), but each
+    round is an O(E) segment reduce instead of a [T, T] matmul.  Cycle
+    members never peel and keep level -1.  Returns [T] int32."""
+    t = active.shape[0]
+    em = evalid & active[src] & active[dst]
+
+    def cond(carry):
+        _, done, it = carry
+        return (it < t) & jnp.any(active & ~done)
+
+    def body(carry):
+        level, done, it = carry
+        contrib = (em & ~done[dst]).astype(jnp.int32)
+        blocked = jnp.zeros((t,), jnp.int32).at[src].max(
+            contrib, mode="drop") > 0
+        newly = active & ~done & ~blocked
+        progressed = jnp.any(newly)
+        level = jnp.where(newly, it, level)
+        done = done | newly
+        it = jnp.where(progressed, it + 1, t)   # no progress => cycle: stop
+        return level, done, it
+
+    level0 = jnp.full((t,), -1, dtype=jnp.int32)
+    level, _, _ = jax.lax.while_loop(cond, body, (level0, ~active,
+                                                  jnp.int32(0)))
+    return level
+
+
+# ---------------------------------------------------------------------------
+# SCC condensation by trim + min-label wavefront coloring
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def scc_condense_edges(src: jax.Array, dst: jax.Array, evalid: jax.Array,
+                       active: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """``deps_kernels.scc_condense`` over an edge list, without ever forming
+    reach & reach.T.  Three wavefront phases:
+
+    1. TRIM: peel nodes that cannot sit on a cycle (no in- or no out-edge
+       within the remaining core) — these are singleton SCCs labeled by
+       their own slot, and on protocol graphs (cycles bounded by
+       concurrency) they are almost everything.
+    2. EXTRACT (outer loop, >= 1 SCC per round): flood the min reachable
+       ancestor label forward to fixpoint ("color"); a node whose color is
+       its own index is a root, and the nodes of its color class that reach
+       it backward are exactly SCC(root) — the flood and the backward reach
+       both stay inside the color class by construction, so the restriction
+       loses nothing.  Extracted labels are the min member slot, matching
+       the dense kernel bit-for-bit.
+    3. LEVELS: the same condensed-component peeling the dense kernel runs,
+       with the per-round blocked/comp_blocked reductions as edge-list
+       segment ops.
+
+    Returns (labels [T] int32, level [T] int32)."""
+    t = active.shape[0]
+    idx = jnp.arange(t, dtype=jnp.int32)
+    em = evalid & active[src] & active[dst]
+
+    def trim(core):
+        def tcond(carry):
+            _, changed = carry
+            return changed
+
+        def tbody(carry):
+            core, _ = carry
+            e = (em & core[src] & core[dst]).astype(jnp.int32)
+            has_out = jnp.zeros((t,), jnp.int32).at[src].max(
+                e, mode="drop") > 0
+            has_in = jnp.zeros((t,), jnp.int32).at[dst].max(
+                e, mode="drop") > 0
+            new = core & has_out & has_in
+            return new, jnp.any(new != core)
+
+        core, _ = jax.lax.while_loop(tcond, tbody, (core, jnp.bool_(True)))
+        return core
+
+    labels0 = jnp.where(active, idx, -1)   # singletons label themselves
+    core0 = trim(active)
+
+    def ocond(carry):
+        _, core, it = carry
+        return (it < t) & jnp.any(core)
+
+    def obody(carry):
+        labels, core, it = carry
+        e_core = em & core[src] & core[dst]
+
+        # forward min-ancestor flood (rounds ~ SCC diameter)
+        def pcond(carry2):
+            _, changed = carry2
+            return changed
+
+        def pbody(carry2):
+            color, _ = carry2
+            cand = jnp.where(e_core, color[src], t)
+            upd = jnp.full((t,), t, jnp.int32).at[dst].min(cand, mode="drop")
+            new = jnp.minimum(color, upd)
+            return new, jnp.any(new != color)
+
+        color0 = jnp.where(core, idx, t)
+        color, _ = jax.lax.while_loop(pcond, pbody, (color0, jnp.bool_(True)))
+
+        # backward reach to each root, restricted to its color class
+        def bcond(carry2):
+            _, changed = carry2
+            return changed
+
+        def bbody(carry2):
+            flag, _ = carry2
+            cand = (e_core & flag[dst]
+                    & (color[src] == color[dst])).astype(jnp.int32)
+            upd = jnp.zeros((t,), jnp.int32).at[src].max(
+                cand, mode="drop") > 0
+            new = flag | (core & upd)
+            return new, jnp.any(new != flag)
+
+        flag0 = core & (color == idx)
+        flag, _ = jax.lax.while_loop(bcond, bbody, (flag0, jnp.bool_(True)))
+        labels = jnp.where(flag, color, labels)
+        return labels, trim(core & ~flag), it + 1
+
+    labels, _, _ = jax.lax.while_loop(ocond, obody,
+                                      (labels0, core0, jnp.int32(0)))
+
+    # condensed topological levels — the dense kernel's peeling, edge-parallel
+    cond_e = em & (labels[src] != labels[dst])
+
+    def lcond(carry):
+        _, done, it = carry
+        return (it < t) & jnp.any(active & ~done)
+
+    def lbody(carry):
+        level, done, it = carry
+        contrib = (cond_e & ~done[dst]).astype(jnp.int32)
+        blocked = jnp.zeros((t,), jnp.int32).at[src].max(
+            contrib, mode="drop") > 0
+        comp_blocked = jnp.zeros((t,), jnp.int32).at[labels].max(
+            (blocked & active & ~done).astype(jnp.int32), mode="drop")
+        ready = active & ~done & (comp_blocked[labels] == 0)
+        progressed = jnp.any(ready)
+        level = jnp.where(ready, it, level)
+        done = done | ready
+        it = jnp.where(progressed, it + 1, t)
+        return level, done, it
+
+    level0 = jnp.full((t,), -1, dtype=jnp.int32)
+    level, _, _ = jax.lax.while_loop(lcond, lbody, (level0, ~active,
+                                                    jnp.int32(0)))
+    return labels, level
+
+
+# ---------------------------------------------------------------------------
+# Host wrappers (pow2 jit buckets; dense-twin signatures for the cross-check)
+# ---------------------------------------------------------------------------
+
+def _prep_edges(src, dst):
+    s, d, v = _pad_edges(src, dst, _pow2(len(src)))
+    return jnp.asarray(s), jnp.asarray(d), jnp.asarray(v)
+
+
+def _prep(adj, active=None):
+    a = np.asarray(adj)
+    t = a.shape[0]
+    src, dst = edges_from_dense(a)
+    s, d, v = _prep_edges(src, dst)
+    act = np.ones((t,), dtype=bool) if active is None \
+        else np.asarray(active, dtype=bool)
+    return t, s, d, v, jnp.asarray(act)
+
+
+def kahn_frontier_csr(adj, status, active) -> np.ndarray:
+    """Frontier-tier twin of ``deps_kernels.kahn_frontier`` (dense in,
+    [T] bool out) — for the cross-check tier and bench."""
+    t, s, d, v, act = _prep(adj, active)
+    return np.asarray(kahn_frontier_edges(
+        s, d, v, jnp.asarray(np.asarray(status)), act))
+
+
+def kahn_levels_csr(adj, active) -> np.ndarray:
+    t, s, d, v, act = _prep(adj, active)
+    return np.asarray(kahn_levels_edges(s, d, v, act))
+
+
+def scc_condense_csr(adj, active) -> Tuple[np.ndarray, np.ndarray]:
+    t, s, d, v, act = _prep(adj, active)
+    labels, level = scc_condense_edges(s, d, v, act)
+    return np.asarray(labels), np.asarray(level)
+
+
+def closure_condensed(adj):
+    """The decision-bearing form of the transitive closure: per-node compact
+    component index [T], packed component-level reachability [C, ceil(C/8)]
+    (uint8, little-endian bits), and the nontrivial-component mask [C].
+    ``reach[i, j] == comp_reach[comp[i]] bit comp[j] | (comp[i] == comp[j]
+    and nontrivial)`` — ``transitive_closure_csr`` is exactly this view
+    expanded dense, so ordering decisions read the condensed form directly
+    and only the cross-check tier ever pays the [T, T] materialization."""
+    return _condensation(np.asarray(adj))
+
+
+def _condensation(a: np.ndarray, edges=None):
+    """(per-node compact comp index, packed comp-level reachability,
+    nontrivial mask, comp count) for a dense adjacency — the shared
+    substrate of ``transitive_closure_csr`` and ``elide_csr``.
+
+    Comp-level reachability is a level-synchronous packed-bitset DP over the
+    condensation DAG: processing components dep-first (increasing Kahn
+    level), each component's row is the OR of (dep's bit | dep's row) over
+    its out-edges — uint8-packed so a T = 8k graph's whole closure is C/8
+    bytes per row instead of a [T, T] matmul chain."""
+    n = a.shape[0]
+    if edges is None:
+        edges = edges_from_dense(a)
+    src, dst = edges
+    s, d, v = _prep_edges(src, dst)
+    labels, _ = scc_condense_edges(s, d, v,
+                                   jnp.asarray(np.ones((n,), dtype=bool)))
+    comp_of = np.asarray(labels).astype(np.int64)  # label = min member slot
+    comp_ids, node_comp = np.unique(comp_of, return_inverse=True)
+    c = len(comp_ids)
+    csrc, cdst = node_comp[src], node_comp[dst]
+    # nontrivial component: >= 2 members, or a self-loop member
+    sizes = np.bincount(node_comp, minlength=c)
+    nontrivial = sizes > 1
+    self_loops = csrc[src == dst]
+    nontrivial[self_loops] = True
+    # condensation edges (deduped)
+    cross = csrc != cdst
+    if cross.any():
+        ce = np.unique(np.stack([csrc[cross], cdst[cross]], axis=1), axis=0)
+        ce_src, ce_dst = ce[:, 0].astype(np.int32), ce[:, 1].astype(np.int32)
+    else:
+        ce_src = ce_dst = np.zeros((0,), dtype=np.int32)
+    # dep-first order over the (acyclic) condensation
+    cadj_levels = np.zeros((c,), dtype=np.int64)
+    if len(ce_src):
+        s, d, v = _pad_edges(ce_src, ce_dst, _pow2(len(ce_src)))
+        cadj_levels = np.asarray(kahn_levels_edges(
+            jnp.asarray(s), jnp.asarray(d), jnp.asarray(v),
+            jnp.asarray(np.ones((c,), dtype=bool)))).astype(np.int64)
+    words = (c + 7) // 8
+    reach_p = np.zeros((c, words), dtype=np.uint8)
+    if len(ce_src):
+        bit = np.zeros((c, words), dtype=np.uint8)
+        bit[np.arange(c), np.arange(c) // 8] = 1 << (np.arange(c) % 8)
+        order = np.argsort(ce_src, kind="stable")
+        e_src, e_dst = ce_src[order], ce_dst[order]
+        lev_of_edge = cadj_levels[e_src]
+        for lv in np.unique(lev_of_edge):
+            sel = lev_of_edge == lv
+            s_lv, d_lv = e_src[sel], e_dst[sel]
+            rows = reach_p[d_lv] | bit[d_lv]          # dep's row | dep's bit
+            starts = np.flatnonzero(np.diff(s_lv, prepend=-1))
+            merged = np.bitwise_or.reduceat(rows, starts, axis=0)
+            reach_p[s_lv[starts]] |= merged
+    return node_comp, reach_p, nontrivial, c
+
+
+def _unpack_cols(packed: np.ndarray, c: int) -> np.ndarray:
+    return np.unpackbits(packed, axis=1, bitorder="little")[:, :c].astype(bool)
+
+
+def transitive_closure_csr(adj) -> np.ndarray:
+    """Frontier-tier twin of ``deps_kernels.transitive_closure``: SCC
+    condensation + packed-bitset DP over the condensation DAG, expanded back
+    to a dense [T, T] bool reach matrix.  Bit-identical to the dense kernel
+    on any graph (cycles included): reach[i, j] iff comp(i) reaches comp(j)
+    in the condensation, or they share a nontrivial component."""
+    a = np.asarray(adj) != 0
+    node_comp, reach_p, nontrivial, c = _condensation(a)
+    comp_reach = _unpack_cols(reach_p, c)            # [C, C]
+    comp_reach[np.arange(c), np.arange(c)] |= nontrivial
+    return comp_reach[np.ix_(node_comp, node_comp)]
+
+
+def elide_csr(adj) -> np.ndarray:
+    """Frontier-tier twin of ``deps_kernels.elide`` (transitive reduction,
+    cycle edges kept).  An edge (i, j) is implied iff some dependency k of i
+    reaches j — evaluated per EDGE against the packed component reachability
+    rows (one gather + segment-OR over the edge list), never as the dense
+    a @ reach matmul."""
+    a = np.asarray(adj) != 0
+    n = a.shape[0]
+    src, dst = edges_from_dense(a)
+    if not len(src):
+        return np.zeros_like(a)
+    node_comp, reach_p, nontrivial, c = _condensation(a, edges=(src, dst))
+    words = reach_p.shape[1]
+    bit = np.zeros((c, words), dtype=np.uint8)
+    bit[np.arange(c), np.arange(c) // 8] = 1 << (np.arange(c) % 8)
+    # reach*[k] row = comps reachable from k with >= 1 step (incl. own comp
+    # when nontrivial)
+    star = reach_p | np.where(nontrivial[:, None], bit, 0)
+    # implied rows per node: OR of star[comp(k)] over i's dep edges (i, k)
+    order = np.argsort(src, kind="stable")
+    e_src, e_dst = src[order], dst[order]
+    rows = star[node_comp[e_dst]]                    # [E, words]
+    starts = np.flatnonzero(np.diff(e_src, prepend=-1))
+    implied_p = np.zeros((n, words), dtype=np.uint8)
+    implied_p[e_src[starts]] = np.bitwise_or.reduceat(rows, starts, axis=0)
+    # per-edge verdict
+    cj = node_comp[dst]
+    implied_edge = (implied_p[src, cj // 8] >> (cj % 8).astype(np.uint8)) & 1
+    in_cycle = (node_comp[src] == cj) & nontrivial[cj]
+    keep = (implied_edge == 0) | in_cycle
+    out = np.zeros_like(a)
+    out[src[keep], dst[keep]] = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Resolver frontier entry (dict-of-edges ingress, no dense matrix ever)
+# ---------------------------------------------------------------------------
+
+def frontier_ready_from_edges(edge_src: np.ndarray, edge_dst: np.ndarray,
+                              status: np.ndarray,
+                              active: np.ndarray) -> np.ndarray:
+    """The command-store release path: compacted wait-graph edge arrays in,
+    ready mask out — pow2-bucketed on (E, T) so steady-state compilations
+    stay bounded like the consult kernels.  [T] bool."""
+    t = len(status)
+    t_pad = _pow2(t)
+    e_pad = _pow2(len(edge_src))
+    s, d, v = _pad_edges(edge_src.astype(np.int32), edge_dst.astype(np.int32),
+                         e_pad)
+    st = np.zeros((t_pad,), dtype=status.dtype)
+    ac = np.zeros((t_pad,), dtype=bool)
+    st[:t] = status
+    ac[:t] = active
+    ready = np.asarray(kahn_frontier_edges(
+        jnp.asarray(s), jnp.asarray(d), jnp.asarray(v),
+        jnp.asarray(st), jnp.asarray(ac)))
+    return ready[:t]

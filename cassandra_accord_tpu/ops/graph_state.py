@@ -169,3 +169,11 @@ def evict_mask(state: GraphState, keep: jax.Array) -> GraphState:
 def to_host_deps(state: GraphState) -> np.ndarray:
     """Adjacency back to host as a dense bool matrix (for parity checks)."""
     return np.asarray(state.adj, dtype=np.int8) != 0
+
+
+def adj_edges(state: GraphState):
+    """The adjacency as host (src, dst) int32 edge lists — the frontier
+    tier's CSR ingress (ops.frontier_kernels): work proportional to edges,
+    not slots.  Edge (i, j) = txn i waits on txn j, matching ``adj``."""
+    src, dst = np.nonzero(np.asarray(state.adj, dtype=np.int8))
+    return src.astype(np.int32), dst.astype(np.int32)

@@ -15,8 +15,8 @@ import numpy as np
 
 from ..local.status import SaveStatus, Status
 from ..primitives.timestamp import TxnId, TxnKind
-from .columns import (F_AWAITS_ONLY, F_HAS_EA, F_PRE_COMMITTED, F_TRUNCATED,
-                      TxnBatch, lanes_lt, pack_order_lanes)
+from .columns import (ENGAGE_FLOOR, F_AWAITS_ONLY, F_HAS_EA, F_PRE_COMMITTED,
+                      F_TRUNCATED, TxnBatch, lanes_lt, pack_order_lanes)
 
 _APPLIED_ORD = SaveStatus.APPLIED.ordinal
 _PRE_APPLIED_ORD = SaveStatus.PRE_APPLIED.ordinal
@@ -63,6 +63,8 @@ class BatchEngine:
             "frontier_fast": 0,        # deps answered from the mirror
             "ingress_windows": 0,      # delivery windows fed to the resolver
             "ingress_rows": 0,         # declared deps queries across them
+            "exec_release_scans": 0,   # frontier release-tick partitions
+            "exec_release_fast": 0,    # parked ids answered from the mirror
         }
 
     # -- mirror maintenance (fed from the transition choke points) -----------
@@ -160,6 +162,28 @@ class BatchEngine:
         """The dep fields the skip proof depends on; compared between scalar
         visits — any change invalidates the remaining skips."""
         return (dep.save_status, dep.execute_at, dep.execute_at_least)
+
+    # -- frontier-driven execution (the exec_deferred release tick) -----------
+    def exec_deferred_partition(self, ids: List[TxnId]
+                                ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Partition a frontier release tick's parked ids in ONE gather over
+        the mirror's status column: (known, stable) masks.  ``known & ~
+        stable`` rows are RESIDENT commands whose SaveStatus provably moved
+        past STABLE — the release task may discard them without the scalar
+        ``get_if_exists`` + status check (that visit reads two fields and
+        returns; skipping it cannot change the trajectory).  ``~known`` rows
+        (never mirrored, or evicted — residency tracking follows eviction in
+        both directions) MUST take the scalar path: for them ``get_if_
+        exists`` can fault in, which is an observable store event the skip
+        contract is not allowed to elide.  Returns None below the
+        engagement floor (scalar loop wins there)."""
+        if len(ids) < ENGAGE_FLOOR:
+            return None
+        rows, known = self.batch.rows_for(ids)
+        stable = known & (self.batch.status[rows] == _STABLE_ORD)
+        self.stats["exec_release_scans"] += 1
+        self.stats["exec_release_fast"] += int((known & ~stable).sum())
+        return known, stable
 
     # -- frontier-init dependency classification (initialise_waiting_on) ------
     def still_blocks_mask(self, dep_ids: List[TxnId], execute_at,

@@ -2,7 +2,15 @@
 into ReadyToExecute instead of the event-driven WaitingOn drain firing them
 inline (SURVEY §7 stage 8 'execute-phase topological wait on device';
 VERDICT r03 item 3).  The event path still does all bookkeeping, so a
-frontier that misses a ready txn stalls the run loudly."""
+frontier that misses a ready txn stalls the run loudly.
+
+Round 12 promoted the mode into the FULL hostile matrix: the one-sided
+device mirror leak (KNOWN_ISSUES rounds 6-11) is fixed — terminal SaveStatus
+transitions now reach the resolver mirror through ``note_terminal`` at the
+transition choke point instead of riding the cfk-gated witness path — and
+the old ACCORD_LONG_BURNS xfail repro is the tier-1 regression test below."""
+import os
+
 import pytest
 
 from cassandra_accord_tpu.harness.burn import run_burn
@@ -57,3 +65,110 @@ def test_hostile_burn_frontier_driven_with_churn(monkeypatch):
                       topology_churn=True, resolver="verify",
                       frontier_exec=True, max_tasks=6_000_000)
     assert result.resolved == 40
+
+
+# ---------------------------------------------------------------------------
+# Round 12: the mirror-leak regression suite (KNOWN_ISSUES rounds 6-11 fix)
+# ---------------------------------------------------------------------------
+
+def test_terminal_transition_reaches_device_mirror():
+    """The pinned mirror-leak shape: a terminal transition on the last
+    in-flight dependency must propagate to the device wait-graph mirror
+    before quiescence EVEN WHEN the cfk witness path refuses the update
+    (demoted-cold/pruned entry, churn-dropped key, truncation/GC-erase that
+    never calls register_witness).  ``note_terminal`` is that propagation:
+    without it the dep's mirror row stayed STABLE and the kernel frontier
+    reported it ready forever (device-only=7 / host-only=[] at final
+    quiescence on the round-6 repro)."""
+    from cassandra_accord_tpu.local.cfk import InternalStatus
+    from cassandra_accord_tpu.primitives.timestamp import Timestamp
+    from tests.test_resolver import make_pair, register_both, rk, tid
+
+    store, verify = make_pair()
+    tpu = verify.tpu
+    w, d = tid(10), tid(20)
+    for t, ks in ((w, [rk(0)]), (d, [rk(0)])):
+        register_both(store, verify, t, InternalStatus.PREACCEPTED, None, ks)
+        register_both(store, verify, t, InternalStatus.STABLE,
+                      Timestamp(1, t.hlc + 1, 0, 1), ks)
+    tpu.register_waiting(w, {d})
+    tpu.register_waiting(d, set())
+    assert tpu.frontier_ready() == {d}          # w blocked on d
+    # d reaches APPLIED on the host but the cfk refuses the witness update
+    # (the leak shape): ONLY note_terminal carries it to the mirror — the
+    # waiting edge then points at a done slot and w becomes ready, with NO
+    # remove_waiting ever mirrored
+    verify.note_terminal(d)
+    ready = tpu.frontier_ready()
+    assert d not in ready, "terminal dep still reported execution-ready"
+    assert ready == {w}, f"waiter not released by terminal dep: {ready}"
+    # terminal waiter leaves the frontier and drops its own edges
+    verify.note_terminal(w)
+    assert tpu.frontier_ready() == set()
+    assert w not in tpu.edges
+
+
+def test_note_terminal_invalidated_guard():
+    """The invalidated path honors cfk.update's committed-never-invalidated
+    rule: a committed-or-later mirror row ignores an invalidation signal
+    (same guard as ``register``), a pre-committed row takes it."""
+    from cassandra_accord_tpu.local.cfk import InternalStatus
+    from cassandra_accord_tpu.primitives.timestamp import Timestamp
+    from tests.test_resolver import make_pair, register_both, rk, tid
+
+    store, verify = make_pair()
+    tpu = verify.tpu
+    a, b = tid(10), tid(20)
+    register_both(store, verify, a, InternalStatus.PREACCEPTED, None, [rk(0)])
+    register_both(store, verify, b, InternalStatus.STABLE,
+                  Timestamp(1, b.hlc + 1, 0, 1), [rk(0)])
+    inv = int(InternalStatus.INVALIDATED)
+    verify.note_terminal(a, invalidated=True)
+    assert tpu.txns[a].status == inv
+    verify.note_terminal(b, invalidated=True)   # committed+: must refuse
+    assert tpu.txns[b].status != inv
+
+
+def test_frontier_exec_full_hostile_matrix_parity(monkeypatch):
+    """THE promoted round-6 repro, verbatim config, now expected clean: seed
+    0, 100 ops, full hostile matrix (chaos + churn + durability + journal +
+    delayed stores + clock drift + cache-miss eviction) under frontier-driven
+    execution and strict audit.  The final-quiescence verify_frontiers pass
+    inside run_burn is the oracle that used to throw device-only=7."""
+    result = run_burn(0, ops=100, concurrency=20, resolver="verify",
+                      frontier_exec=True, chaos=True, allow_failures=True,
+                      topology_churn=True, durability=True, journal=True,
+                      delayed_stores=True, clock_drift=True, cache_miss=True,
+                      audit="strict", max_tasks=200_000_000)
+    assert result.resolved == 100
+    assert result.stats.get("frontier_released", 0) > 0, \
+        "frontier mode never released anything — the mode did not engage"
+
+
+def test_frontier_exec_gray_elastic_strict():
+    """Frontier execution composed with the gray-failure plane (pause +
+    disk-stall nemeses) and elastic membership under strict audit — the
+    promotion's widest tier-1 compose."""
+    result = run_burn(3, ops=80, concurrency=16, resolver="verify",
+                      frontier_exec=True, chaos=True, allow_failures=True,
+                      durability=True, journal=True, pause_nodes=True,
+                      disk_stall=True, elastic_membership=True,
+                      topology_churn=True, audit="strict",
+                      max_tasks=200_000_000)
+    assert result.resolved == 80
+
+
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="seed-range frontier matrix; run with ACCORD_LONG_BURNS=1")
+def test_frontier_exec_hostile_matrix_seed_range():
+    """ISSUE 13 acceptance: frontier_exec=True strict-clean across seeds 0-9
+    under the full hostile matrix (zero violations — the in-run audit and
+    the final verify_frontiers parity pass both gate)."""
+    for seed in range(10):
+        result = run_burn(seed, ops=100, concurrency=20, resolver="verify",
+                          frontier_exec=True, chaos=True, allow_failures=True,
+                          topology_churn=True, durability=True, journal=True,
+                          delayed_stores=True, clock_drift=True,
+                          cache_miss=True, audit="strict",
+                          max_tasks=200_000_000)
+        assert result.resolved == 100, f"seed {seed}: {result}"

@@ -256,3 +256,161 @@ def test_consult_packed_matches_consult():
                              bitorder="little").astype(bool)[:, :t]
     assert (unpacked == np.asarray(deps)).all()
     assert (np.asarray(mx) == np.asarray(mx2)).all()
+
+
+# ---------------------------------------------------------------------------
+# Frontier tier (ops.frontier_kernels): bit-identity vs the dense tier
+# ---------------------------------------------------------------------------
+
+def _random_graph(rng, n, p, shape):
+    """Randomized adjacency in one of the adversarial shapes: cyclic (raw),
+    DAG (lower-triangular), or cyclic-with-self-loops."""
+    adj = (rng.random((n, n)) < p).astype(np.int8)
+    if shape == "dag":
+        adj = np.tril(adj, k=-1)
+    elif shape == "cyclic":
+        np.fill_diagonal(adj, 0)
+    return adj   # "selfloops": diagonal kept as drawn
+
+
+def test_frontier_tier_bit_identity(nprng):
+    """Every frontier-tier kernel must agree bit-for-bit with its dense twin
+    on randomized graphs — cycles, DAGs, self-loops, inactive slots.  This
+    is the cross-check-tier contract (the dense kernels stay in-tree exactly
+    for this, the way consult keeps its host fallback)."""
+    from cassandra_accord_tpu.ops import frontier_kernels as fk
+    for trial in range(8):
+        n = int(nprng.integers(2, 128))
+        p = float(nprng.uniform(0.01, 0.3))
+        shape = ("cyclic", "dag", "selfloops")[trial % 3]
+        adj = _random_graph(nprng, n, p, shape)
+        active = nprng.random(n) < 0.9
+        status = np.full(n, gs.STABLE, dtype=np.int8)
+        status[nprng.random(n) < 0.3] = gs.APPLIED
+        status[nprng.random(n) < 0.1] = gs.INVALIDATED
+
+        dense = np.asarray(ops.transitive_closure(jnp.asarray(adj)))
+        assert (dense == fk.transitive_closure_csr(adj)).all(), (trial, shape)
+
+        dense = np.asarray(ops.elide(jnp.asarray(adj)))
+        assert (dense == fk.elide_csr(adj)).all(), (trial, shape)
+
+        dl, dv = ops.scc_condense(jnp.asarray(adj), jnp.asarray(active))
+        fl, fv = fk.scc_condense_csr(adj, active)
+        assert (np.asarray(dl) == fl).all(), (trial, shape)
+        assert (np.asarray(dv) == fv).all(), (trial, shape)
+
+        dense = np.asarray(ops.kahn_levels(jnp.asarray(adj),
+                                           jnp.asarray(active)))
+        assert (dense == fk.kahn_levels_csr(adj, active)).all(), (trial, shape)
+
+        dense = np.asarray(ops.kahn_frontier(
+            jnp.asarray(adj), jnp.asarray(status), jnp.asarray(active)))
+        assert (dense == fk.kahn_frontier_csr(adj, status,
+                                              active)).all(), (trial, shape)
+
+
+def test_closure_condensed_is_the_dense_view(nprng):
+    """``closure_condensed`` (the decision-bearing form the 8k-scale path
+    reads) expands to exactly ``transitive_closure_csr``'s dense matrix."""
+    from cassandra_accord_tpu.ops import frontier_kernels as fk
+    n = 96
+    adj = _random_graph(nprng, n, 0.06, "cyclic")
+    node_comp, reach_p, nontrivial, c = fk.closure_condensed(adj)
+    comp_reach = fk._unpack_cols(reach_p, c)
+    comp_reach[np.arange(c), np.arange(c)] |= nontrivial
+    dense = comp_reach[np.ix_(node_comp, node_comp)]
+    assert (dense == fk.transitive_closure_csr(adj)).all()
+    assert (dense == np.asarray(ops.transitive_closure(jnp.asarray(adj)))).all()
+
+
+def test_frontier_ready_from_edges_matches_dense(nprng):
+    """The command-store release path's CSR entry (edge arrays in, ready
+    mask out) vs the dense kahn_frontier over the equivalent adjacency."""
+    from cassandra_accord_tpu.ops import frontier_kernels as fk
+    for _ in range(6):
+        n = int(nprng.integers(1, 64))
+        e = int(nprng.integers(0, 4 * n))
+        src = nprng.integers(0, n, e).astype(np.int32)
+        dst = nprng.integers(0, n, e).astype(np.int32)
+        status = np.full(n, gs.STABLE, dtype=np.int8)
+        status[nprng.random(n) < 0.4] = gs.APPLIED
+        active = nprng.random(n) < 0.9
+        adj = np.zeros((n, n), dtype=np.int8)
+        adj[src, dst] = 1
+        want = np.asarray(ops.kahn_frontier(
+            jnp.asarray(adj), jnp.asarray(status), jnp.asarray(active)))
+        got = fk.frontier_ready_from_edges(src, dst, status, active)
+        assert (want == got).all()
+
+
+def test_evict_slot_reuse_never_resurrects_edges(nprng):
+    """Satellite audit (the adjacent-bug shape of the round-12 mirror leak):
+    device GraphState eviction + slot reallocation must never leak a stale
+    edge into a fresh txn's frontier.  Randomized evict/reinsert cycles are
+    checked field-exactly against a host model rebuilt from scratch each
+    round — any surviving row/column of an evicted slot, or any edge onto a
+    recycled slot's previous occupant, diverges the frontier."""
+    t, k = 24, 8
+    st = ops.init_state(t, k)
+    model_adj = np.zeros((t, t), dtype=np.int8)
+    model_active = np.zeros(t, dtype=bool)
+    model_status = np.zeros(t, dtype=np.int8)
+    free = list(range(t))
+    occupied = []
+    for rnd in range(12):
+        # insert a batch into (possibly recycled) free slots
+        nb = int(nprng.integers(1, min(6, len(free)) + 1))
+        slots = [free.pop(0) for _ in range(nb)]
+        occupied.extend(slots)
+        deps = np.zeros((nb, t), dtype=np.int8)
+        for i in range(nb):
+            # new txns may depend on any currently-occupied slot
+            for s in occupied:
+                if s not in slots[i:] and nprng.random() < 0.3:
+                    deps[i, s] = 1
+        key_inc = (nprng.random((nb, k)) < 0.4).astype(np.int8)
+        ts = nprng.integers(1, 1000, (nb, gs.TS_LANES)).astype(np.int32)
+        status = np.full(nb, gs.STABLE, dtype=np.int8)
+        st = ops.insert_batch(st, jnp.asarray(np.asarray(slots, np.int32)),
+                              jnp.asarray(key_inc), jnp.asarray(ts),
+                              jnp.asarray(ts),
+                              jnp.asarray(np.ones(nb, np.int8)),
+                              jnp.asarray(status), jnp.asarray(deps))
+        model_adj[slots] = deps
+        model_active[slots] = True
+        model_status[slots] = gs.STABLE
+        # apply + evict a random subset of occupied slots
+        done = [s for s in occupied if nprng.random() < 0.4]
+        if done:
+            st = ops.set_status_batch(
+                st, jnp.asarray(np.asarray(done, np.int32)),
+                jnp.full((len(done),), gs.APPLIED, jnp.int8))
+            model_status[done] = gs.APPLIED
+            keep = np.ones(t, dtype=bool)
+            keep[done] = False
+            st = ops.evict_mask(st, jnp.asarray(keep))
+            # the model of CORRECT eviction: row, column, and metadata gone
+            model_adj[done, :] = 0
+            model_adj[:, done] = 0
+            model_active[done] = False
+            model_status[done] = 0
+            for s in done:
+                occupied.remove(s)
+                free.append(s)
+        # field-exact: no stale edge may survive into any future frontier
+        assert (np.asarray(st.adj) == model_adj).all(), f"round {rnd}"
+        assert (np.asarray(st.active) == model_active).all(), f"round {rnd}"
+        got = np.asarray(ops.kahn_frontier(st.adj, st.status, st.active))
+        want = np.asarray(ops.kahn_frontier(
+            jnp.asarray(model_adj), jnp.asarray(model_status),
+            jnp.asarray(model_active)))
+        assert (got == want).all(), f"round {rnd}: stale edge in frontier"
+        # and the CSR ingress view of the same state (GraphState.adj_edges ->
+        # the frontier tier) agrees — the production release path's shape
+        from cassandra_accord_tpu.ops import frontier_kernels as fk
+        src, dst = ops.adj_edges(st)
+        csr = fk.frontier_ready_from_edges(src, dst,
+                                           np.asarray(st.status),
+                                           np.asarray(st.active))
+        assert (csr == got).all(), f"round {rnd}: CSR/dense frontier split"

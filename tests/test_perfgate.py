@@ -196,8 +196,12 @@ def test_multi_seed_run_measures_each_seed_and_appends_median(
 def test_single_listed_seed_is_measured_as_that_seed(tmp_path, monkeypatch):
     """--seeds with ONE seed measures THAT seed — never silently replaced
     by the default smoke seed (a seed-specific regression must not be
-    gated against the wrong trajectory)."""
+    gated against the wrong trajectory).  Baseline is synthetic so the
+    assertion is about seed ROUTING, not the real tree's values."""
     monkeypatch.setenv("ACCORD_BENCH_HISTORY", str(tmp_path / "h.jsonl"))
+    monkeypatch.setattr(perfgate, "load_baseline",
+                        lambda path=perfgate.BASELINE_PATH:
+                        _synth_baseline([23]))
     measured_seeds = []
 
     def fake_smoke(seed):

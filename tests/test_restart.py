@@ -468,18 +468,13 @@ def test_restart_hostile_matrix_seed_range():
 
 
 # ---------------------------------------------------------------------------
-# Satellite 4: frontier-parity open repro (KNOWN_ISSUES, round-6 harness)
+# Frontier-parity: FIXED round 12 (the round-6 open repro is now the tier-1
+# regression test tests/test_frontier_exec.py::
+# test_frontier_exec_full_hostile_matrix_parity; the seed-range promotion
+# matrix lives beside it behind ACCORD_LONG_BURNS).  Root cause: terminal
+# SaveStatus transitions never reached the device mirror when cfk refused the
+# witness update (demoted-cold/pruned entries, churn-dropped keys) or when
+# truncation/GC-erase bypassed register_witness entirely — the stale
+# mirror-STABLE slot then sat in the kernel frontier as ready forever.
+# Fixed by resolver.note_terminal at the _observe_transition choke point.
 # ---------------------------------------------------------------------------
-
-@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
-                    reason="open KNOWN_ISSUES repro; run with ACCORD_LONG_BURNS=1")
-@pytest.mark.xfail(strict=False,
-                   reason="KNOWN_ISSUES: frontier_exec under the FULL hostile "
-                          "matrix trips the device/host frontier parity check "
-                          "(device-only txn whose host WaitingOn still holds "
-                          "an edge) — open for round 6")
-def test_frontier_exec_full_hostile_matrix_parity_repro():
-    run_burn(0, ops=100, concurrency=20, resolver="verify", frontier_exec=True,
-             chaos=True, allow_failures=True, topology_churn=True,
-             durability=True, journal=True, delayed_stores=True,
-             clock_drift=True, cache_miss=True, max_tasks=200_000_000)

@@ -62,6 +62,11 @@ GATED_METRICS = (
     ("commit_latency_p95_us", 1.5),
     ("sim_ms", 1.5),
     ("messages", 1.5),
+    # round 12: the phase share the frontier/CSR work batters down — gated
+    # so the deps_execute_wait win is HELD, not just measured once.  A sim
+    # share (deterministic, dimensionless): 1.5x means "the execute-wait
+    # share grew by half", i.e. someone re-serialized the execution plane.
+    ("deps_execute_wait_share", 1.5),
 )
 
 
@@ -106,6 +111,11 @@ def measure_smoke(seed: int = SMOKE_SEED) -> dict:
             "sim_ms": res.sim_micros // 1000,
             "messages": messages,
             "commits": res.ops_ok,
+            # the round-12 gated phase share (deps_execute_wait /
+            # deps_commit_wait split the old deps wait by pending plane)
+            "deps_execute_wait_share": round(
+                (budget.get("phases", {}).get("deps_execute_wait") or {})
+                .get("share", 0.0), 4),
         },
         "budget_shares": {c: v["share"] for c, v in budget["classes"].items()},
         "dominating_class": budget["dominating_class"],

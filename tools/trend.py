@@ -188,6 +188,42 @@ def trend_lines(entries: List[dict], last_k: int = 8,
             prev = v
         tail = f"  [{skipped} other-seed run(s) omitted]" if skipped else ""
         lines.append(f"  {key:<26} " + " -> ".join(parts) + tail)
+    # the deps-graph kernel series (bench.py deps_graph stage, round 12):
+    # frontier-tier seconds per kernel at the largest recorded T, with the
+    # old-vs-new speedup where the dense twin was measured.  Wall-clock,
+    # never gated — rendered so the closure/SCC retirement holds visibly
+    # run-over-run.
+    dg_present = [(e, e["deps_graph"]) for e in window
+                  if isinstance(e.get("deps_graph"), dict)]
+    if dg_present:
+        def _top_t(dg):
+            ts = sorted((int(k[1:]) for k in dg if k.startswith("T")),
+                        reverse=True)
+            return ts[0] if ts else None
+        top = _top_t(dg_present[-1][1])
+        if top is not None:
+            for key in ("closure_frontier_s", "scc_frontier_s",
+                        "elide_frontier_s"):
+                same = [dg[f"T{top}"].get(key) for _e, dg in dg_present
+                        if _top_t(dg) == top
+                        and dg.get(f"T{top}", {}).get(key) is not None]
+                if not same:
+                    continue
+                if len(same) >= 2:
+                    parts, prev = [], None
+                    for v in same:
+                        parts.append(f"{v}{_fmt_delta(v, prev)}")
+                        prev = v
+                    lines.append(f"  deps_graph.{key}@T{top}    "
+                                 + " -> ".join(parts)
+                                 + "  (wall-clock: never gated)")
+                else:
+                    lines.append(f"  deps_graph.{key}@T{top}    {same[-1]} "
+                                 f"(no prior same-T run)")
+            er = dg_present[-1][1].get("exec_commit_rate")
+            if isinstance(er, dict) and er:
+                lines.append("  deps_graph.exec_commit_rate  "
+                             + " ".join(f"{k}={v}" for k, v in er.items()))
     # the protocol-throughput series: delta arrows across runs recording the
     # same ramp levels (a different concurrency ceiling is a different
     # measurement, like a different seed cohort)
