@@ -667,6 +667,17 @@ def emit_and_exit(code=0):
                     "sim": (ramp.get("columnar_on") or {})
                     .get("commits_per_sec_sim"),
                 }
+            wslo = RESULT["detail"].get("workload_slo")
+            if wslo:
+                # the workload_slo series tools/trend.py renders: did the
+                # open-loop preset sustain its arrival rate this run
+                record["workload_slo"] = {
+                    "workload": wslo.get("workload"),
+                    "rate_txn_s": wslo.get("rate_txn_s"),
+                    "sim_minutes": wslo.get("sim_minutes"),
+                    "slo_burn_events": wslo.get("slo_burn_events"),
+                    "sustained": wslo.get("sustained"),
+                }
             # the seed cohort keys run-over-run comparability in
             # tools/trend.py — a bench smoke record and a perfgate record
             # of the same seed are the same measurement
@@ -901,6 +912,44 @@ def main():
     ps = stage("protocol_slo", protocol_slo)
     if ps is not None:
         d["protocol_slo"] = ps
+
+    def workload_slo():
+        # open-loop arrival-rate SLO preset (ISSUE-16): sustain a target
+        # txn/s of SIM-time under the hostile matrix with the burn-rate
+        # monitors as the oracle — zero slo.burn events = sustained.  The
+        # independent history oracle rides along (check="history": any
+        # strict-serializability anomaly in the client-visible history
+        # raises).  Ledgered as the workload_slo series in BENCH_HISTORY.
+        from cassandra_accord_tpu.harness.burn import run_burn
+        from cassandra_accord_tpu.observe import BurnRateMonitor, InvariantAuditor
+
+        rate = 30.0
+        monitor = BurnRateMonitor()
+        auditor = InvariantAuditor(mode="warn", burnrate=monitor)
+        t0 = time.perf_counter()
+        res = run_burn(seed=PROTO_SEED, ops=240, concurrency=PROTO_CONC,
+                       chaos=True, allow_failures=True, durability=True,
+                       journal=True, delayed_stores=True, clock_drift=True,
+                       workload="openloop", rate_txn_s=rate, check="history",
+                       observer=auditor, audit="warn",
+                       stall_watchdog_s=300.0, max_tasks=80_000_000)
+        dt = time.perf_counter() - t0
+        rep = monitor.report()
+        events = rep.get("slo_burn_events", 0)
+        return {
+            "workload": "openloop", "rate_txn_s": rate,
+            "ops": res.resolved,
+            "sim_minutes": round(res.sim_micros / 60e6, 2),
+            "slo_burn_events": events,
+            "sustained": events == 0,
+            "history": {k: res.history[k] for k in ("ops", "ok", "keys")}
+            if res.history else None,
+            "wall_s": round(dt, 2),
+        }
+
+    ws = stage("workload_slo", workload_slo)
+    if ws is not None:
+        d["workload_slo"] = ws
 
     def frontier():
         # frontier-driven execution in the flagship configuration

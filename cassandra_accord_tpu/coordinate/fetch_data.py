@@ -26,8 +26,26 @@ if TYPE_CHECKING:
 def check_status_quorum(node: "Node", txn_id: TxnId, route: Route,
                         include_info: bool = True) -> au.AsyncResult:
     """CheckStatus at a quorum of every intersecting shard; resolves with the
-    merged CheckStatusOk."""
+    merged CheckStatusOk.
+
+    Gated on ``node.with_epoch(txn_id.epoch)`` (FetchData.java wraps the
+    probe in ``node.withEpoch(srcEpoch, ...)``): a replica can learn of a
+    blocked txn through deps/inform traffic BEFORE its config service has
+    delivered the txn's epoch — under elastic membership the progress log
+    then probes an epoch the local topology manager cannot slice yet, and
+    ``precise_epochs`` throws instead of waiting.  When the epoch is already
+    known ``with_epoch`` completes synchronously, so the gated path is
+    byte-identical to the ungated one on every established trajectory.
+    """
     result = au.settable()
+    node.with_epoch(txn_id.epoch).begin(
+        lambda _v, f: result.set_failure(f) if f is not None
+        else _check_status_quorum(node, txn_id, route, include_info, result))
+    return result
+
+
+def _check_status_quorum(node: "Node", txn_id: TxnId, route: Route,
+                         include_info: bool, result) -> None:
     topologies = node.topology.precise_epochs(route, txn_id.epoch, txn_id.epoch)
     tracker = QuorumTracker(topologies)
     merged: dict = {"ok": None}
@@ -59,7 +77,6 @@ def check_status_quorum(node: "Node", txn_id: TxnId, route: Route,
         node.send(to, CheckStatus(txn_id, scope,
                                   TxnRequest.compute_wait_for_epoch(to, topologies),
                                   include_info=include_info), callback)
-    return result
 
 
 def fetch_data(node: "Node", txn_id: TxnId, route: Route) -> au.AsyncResult:

@@ -48,6 +48,7 @@ class BurnResult:
         self.sim_micros = 0
         self.stats: Dict[str, int] = {}
         self.audit: Optional[dict] = None   # InvariantAuditor verdict, if on
+        self.history: Optional[dict] = None  # history-checker report, if on
 
     @property
     def resolved(self) -> int:
@@ -153,6 +154,11 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              profiler=None,
              audit: str = "off",
              audit_slo_s: Optional[float] = None,
+             check: str = "off",
+             history_recorder=None,
+             workload=None,
+             rate_txn_s: float = 25.0,
+             control_timeout_s: float = 60.0,
              progress_every_s: Optional[float] = None,
              progress_label: str = "") -> BurnResult:
     """Run one seeded burn; raises SimulationException on any violation.
@@ -212,10 +218,35 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     the unattended-txn liveness budget (sim-seconds).  The auditor IS a
     FlightRecorder, so ``observer`` must be left None (one is created) or
     already be an InvariantAuditor.
+
+    ``check``: ``"history"`` records the client-visible operation history
+    (observe/history.py — invoke/ok/fail/info per op, observed version lists
+    per key) and runs the protocol-blind Elle-style checker
+    (observe/checker.py) over it after final state is judged.  Any named
+    anomaly (G0/G1c/G-single/G2/lost-update/non-repeatable-read/...) raises
+    through SimulationException with the offending sub-history; a clean run
+    stores the report on ``result.history``.  Composes with ``audit``
+    (independent oracles).  Recording is a passive sink — zero observer
+    effect, proven by tests/test_history_checker.py.
+
+    ``workload``: None keeps the classic inline generator (byte-identical
+    trajectories for every existing seed); a preset name
+    (``multirange``/``zipf``/``openloop``) or a ``harness.workload.Workload``
+    instance switches generation to that shape.  ``rate_txn_s`` sets the
+    openloop Poisson arrival rate (sim txn/s); openloop ignores the
+    ``concurrency`` window.  ``control_timeout_s``: barrier/sync-point ops
+    (multirange) have no txn id the client could probe, so an unresolved
+    control op resolves as lost after this much sim-time.
     """
     from ..config import LocalConfig
     if audit not in ("off", "strict", "warn"):
         raise ValueError(f"audit must be off/strict/warn, got {audit!r}")
+    if check not in ("off", "history"):
+        raise ValueError(f"check must be off/history, got {check!r}")
+    history_rec = history_recorder
+    if check == "history" and history_rec is None:
+        from ..observe.history import HistoryRecorder
+        history_rec = HistoryRecorder()
     if audit != "off":
         from ..observe.audit import InvariantAuditor
         if observer is None:
@@ -412,6 +443,15 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     verifier = StrictSerializabilityVerifier()
     result = BurnResult(seed)
     zipf = rng.next_boolean()
+    workload_obj = None
+    if workload is not None:
+        # the preset draws from its OWN fork of the seeded stream: a seed
+        # still fully determines the workload, and the fork keeps the main
+        # stream's draw sequence independent of per-op generation arity
+        from .workload import make_workload
+        workload_obj = make_workload(workload, rate_txn_s=rate_txn_s)
+        workload_obj.bind(rng.fork(), key_count=key_count, bound=bound,
+                          ops=ops)
 
     def key_for(i: int) -> IntKey:
         idx = rng.next_zipf(key_count) if zipf else rng.next_int(key_count)
@@ -453,8 +493,10 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         obs = rec["obs"]
         state["in_flight"] -= 1
         now = cluster.now_micros
-        if observer is not None:
+        if observer is not None and rec["txn_id"] is not None:
             observer.on_resolve(rec["txn_id"], kind, now)
+        if history_rec is not None and not rec.get("control"):
+            history_rec.resolve(rec["op_id"], kind, now, reads, writes)
         if kind == "ok":
             obs.complete(now, reads or {}, writes or {})
             result.ops_ok += 1
@@ -542,7 +584,100 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         check_status_quorum(coordinator, txn_id, route, include_info=True) \
             .to_chain().begin(on_checked)
 
+    def dispatch_txn(op_id: int, txn, read_keys, writes) -> None:
+        """Submit one data txn: verifier observation, client record, history
+        invoke, coordinate + resolution callback (shared by the classic
+        generator and every workload preset)."""
+        coordinator = pick_coordinator()
+        txn_id = coordinator.next_txn_id(txn.kind, txn.domain)
+        route = txn.to_route()
+        obs = verifier.begin(cluster.now_micros)
+        rec = {"op_id": op_id, "obs": obs, "txn_id": txn_id, "route": route,
+               "writes": dict(writes), "coordinator": coordinator.id,
+               "settled": False}
+        inflight[op_id] = rec
+        if history_rec is not None:
+            history_rec.invoke(op_id, txn_id, cluster.now_micros,
+                               read_keys, writes)
+        if observer is not None:
+            observer.on_submit(op_id, txn_id, coordinator.id,
+                               cluster.now_micros)
+        if on_submit is not None:
+            on_submit(op_id, txn_id, txn, coordinator.id)
+
+        def on_done(value, failure, rec=rec, coordinator=coordinator):
+            if failure is None and isinstance(value, ListResult):
+                resolve(rec, "ok", reads=dict(value.reads),
+                        writes=dict(rec["writes"]))
+            elif isinstance(failure, Invalidated):
+                resolve(rec, "nacked", writes=dict(rec["writes"]))
+            elif chaos or restart_nodes \
+                    or isinstance(failure, CoordinationFailed):
+                # response lost in the chaos: resolve through the home shard
+                probe(coordinator, rec, 0)
+            else:
+                resolve(rec, "failed")
+
+        coordinator.coordinate(txn, txn_id=txn_id).add_listener(on_done)
+
+    def dispatch_control(op_id: int, control) -> None:
+        """Interactive op (barrier / sync point) through the coordinate
+        surface.  No txn id exists before coordination allocates one, so the
+        client cannot CheckStatus-probe it: an op whose callbacks died (e.g.
+        its coordinator crashed) resolves as lost at a sim-time deadline."""
+        coordinator = pick_coordinator()
+        obs = verifier.begin(cluster.now_micros)
+        rec = {"op_id": op_id, "obs": obs, "txn_id": None, "route": None,
+               "writes": {}, "coordinator": coordinator.id,
+               "settled": False, "control": True}
+        inflight[op_id] = rec
+        rec["deadline"] = cluster.scheduler.once(
+            control_timeout_s, lambda rec=rec: resolve(rec, "lost"))
+
+        def on_ctl_done(value, failure, rec=rec):
+            timer = rec.pop("deadline", None)
+            if timer is not None:
+                timer.cancel()
+            if rec["settled"]:
+                return
+            if failure is None:
+                resolve(rec, "ok")
+            elif isinstance(failure, Invalidated):
+                resolve(rec, "nacked")
+            elif chaos or restart_nodes \
+                    or isinstance(failure, CoordinationFailed):
+                resolve(rec, "lost")
+            else:
+                resolve(rec, "failed")
+
+        if control[0] == "barrier":
+            _kind, btype, seekables = control
+            res = coordinator.barrier(seekables,
+                                      min_epoch=coordinator.epoch(),
+                                      barrier_type=btype)
+        else:
+            _kind, seekables = control
+            res = coordinator.sync_point(seekables, blocking=False)
+        res.add_listener(on_ctl_done)
+
+    def submit_workload_op() -> None:
+        op_id = state["submitted"]
+        state["submitted"] += 1
+        state["in_flight"] += 1
+        wop = workload_obj.next_op(op_id)
+        if wop.control is not None:
+            dispatch_control(op_id, wop.control)
+        else:
+            dispatch_txn(op_id, wop.txn, wop.read_keys, wop.writes)
+
     def submit_next() -> None:
+        if workload_obj is not None:
+            if workload_obj.open_loop:
+                return   # arrivals are timer-driven, not window-driven
+            while state["in_flight"] < concurrency \
+                    and state["submitted"] < ops:
+                submit_workload_op()
+            return
         while state["in_flight"] < concurrency and state["submitted"] < ops:
             op_id = state["submitted"]
             state["submitted"] += 1
@@ -559,6 +694,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                     rngs.append(Range(IntKey(start),
                                       IntKey(min(bound, start + width))))
                 txn = range_read_txn(Ranges.of(*rngs))
+                reads = []
                 writes = {}
             else:
                 nkeys = rng.next_int(1, 4)
@@ -568,34 +704,23 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 writes = {key: f"v{op_id}.{ki}" for ki, key in enumerate(keys)} \
                     if kind in ("write", "rw") else {}
                 txn = list_txn(reads, writes)
-            coordinator = pick_coordinator()
-            txn_id = coordinator.next_txn_id(txn.kind, txn.domain)
-            route = txn.to_route()
-            obs = verifier.begin(cluster.now_micros)
-            rec = {"op_id": op_id, "obs": obs, "txn_id": txn_id, "route": route,
-                   "writes": dict(writes), "coordinator": coordinator.id,
-                   "settled": False}
-            inflight[op_id] = rec
-            if observer is not None:
-                observer.on_submit(op_id, txn_id, coordinator.id,
-                                   cluster.now_micros)
-            if on_submit is not None:
-                on_submit(op_id, txn_id, txn, coordinator.id)
+            dispatch_txn(op_id, txn, tuple(reads), writes)
 
-            def on_done(value, failure, rec=rec, coordinator=coordinator):
-                if failure is None and isinstance(value, ListResult):
-                    resolve(rec, "ok", reads=dict(value.reads),
-                            writes=dict(rec["writes"]))
-                elif isinstance(failure, Invalidated):
-                    resolve(rec, "nacked", writes=dict(rec["writes"]))
-                elif chaos or restart_nodes \
-                        or isinstance(failure, CoordinationFailed):
-                    # response lost in the chaos: resolve through the home shard
-                    probe(coordinator, rec, 0)
-                else:
-                    resolve(rec, "failed")
+    def schedule_arrivals() -> None:
+        """Open-loop: Poisson arrivals on the sim clock — submit at the drawn
+        instants regardless of what is in flight."""
+        def fire():
+            if state["submitted"] >= ops:
+                return
+            submit_workload_op()
+            arm()
 
-            coordinator.coordinate(txn, txn_id=txn_id).add_listener(on_done)
+        def arm():
+            if state["submitted"] >= ops:
+                return
+            cluster.scheduler.once(workload_obj.next_arrival_s(), fire)
+
+        arm()
 
     membership_nemesis = None
     if elastic_membership:
@@ -616,7 +741,10 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             # process): resolve each through home-shard probes from a live
             # node, exactly like a lost response under chaos
             for rec in list(inflight.values()):
-                if rec["coordinator"] == victim and not rec["settled"]:
+                if rec["coordinator"] == victim and not rec["settled"] \
+                        and not rec.get("control"):
+                    # control ops (barrier/sync point) have no txn id to
+                    # probe; their sim-time deadline resolves them as lost
                     cluster.scheduler.once(
                         0.1 + rng.next_float(),
                         lambda rec=rec: probe(pick_coordinator(), rec, 0))
@@ -679,7 +807,10 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             print(line, flush=True)
         heartbeat_task = cluster.scheduler.recurring(float(progress_every_s),
                                                      heartbeat)
-    submit_next()
+    if workload_obj is not None and workload_obj.open_loop:
+        schedule_arrivals()
+    else:
+        submit_next()
 
     try:
         cluster.run_until(lambda: result.resolved >= ops, max_tasks=max_tasks)
@@ -838,6 +969,13 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             for node in cluster.nodes.values():
                 for store in node.command_stores.all_stores():
                     cluster.journal.verify_against(store)
+        if check == "history":
+            # the independent oracle: replays the CLIENT-VISIBLE history with
+            # zero protocol knowledge; raises HistoryAnomaly on any cycle
+            from ..observe.checker import check_history
+            result.history = check_history(
+                history_rec.ops, final_state=final,
+                spans=getattr(observer, "spans", None))
     except BaseException as e:  # noqa: BLE001
         if profiler is not None:
             try:
@@ -892,6 +1030,50 @@ def reconcile(seed: int, **kwargs):
         f"nondeterministic message counts for seed {seed}: " \
         f"{ {k: (sa.get(k), sb.get(k)) for k in set(sa) | set(sb) if sa.get(k) != sb.get(k)} }"
     return a, b
+
+
+def _append_trend(record: dict) -> None:
+    """Ledger a record into BENCH_HISTORY.jsonl via tools/trend.py.
+    Best-effort: the ledger must never be able to fail a burn."""
+    try:
+        import os as _os
+        import sys as _sys
+        root = _os.path.dirname(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))))
+        if root not in _sys.path:
+            _sys.path.insert(0, root)
+        from tools.trend import append_entry
+        append_entry(record)
+    except Exception:  # noqa: BLE001 — the ledger must never fail a burn
+        pass
+
+
+def _sweep_worker(seed: int, kw: dict) -> dict:
+    """One seed of a ``--parallel-seeds`` sweep.  Module-level so the spawn
+    pool can pickle it; observer-free (the sweep is a pass/fail matrix —
+    replay a failed seed singly for artifacts).  Never raises: a failure
+    becomes a status entry so the cohort always completes."""
+    import time as _time
+    t0 = _time.perf_counter()
+    entry = {"seed": seed}
+    try:
+        result = run_burn(seed, **kw)
+        entry.update(status="pass", resolved=result.resolved,
+                     ok=result.ops_ok, recovered=result.ops_recovered,
+                     nacked=result.ops_nacked, lost=result.ops_lost,
+                     failed=result.ops_failed,
+                     sim_ms=result.sim_micros // 1000)
+        if result.history is not None:
+            entry["history"] = {k: result.history[k]
+                                for k in ("ops", "ok", "keys", "edges")}
+        if getattr(result, "audit", None) is not None:
+            entry["audit"] = result.audit
+    except SimulationException as e:
+        entry.update(status="fail", error=str(e.cause)[:2000])
+    except Exception as e:  # noqa: BLE001 — report, don't kill the pool
+        entry.update(status="fail", error=repr(e)[:2000])
+    entry["wall_s"] = round(_time.perf_counter() - t0, 3)
+    return entry
 
 
 def main(argv=None) -> None:
@@ -970,6 +1152,32 @@ def main(argv=None) -> None:
                    help="auditor liveness budget: flag a txn undecided this "
                         "many sim-seconds with no recovery attempt "
                         "attributed (default 10)")
+    p.add_argument("--check", default="off", choices=["off", "history"],
+                   help="independent history oracle (observe/checker.py): "
+                        "record the client-visible invoke/ok/fail/info "
+                        "history and verify strict serializability over it "
+                        "with ZERO protocol knowledge — version orders from "
+                        "unique write values, wr/ww/rw + real-time edges, "
+                        "any cycle named (G0/G1c/G-single/G2/-realtime, "
+                        "lost-update, non-repeatable-read) with the "
+                        "offending sub-history.  Composes with --audit")
+    p.add_argument("--workload", default=None,
+                   choices=["multirange", "zipf", "openloop"],
+                   help="traffic shape preset (harness/workload.py): "
+                        "multirange = cross-shard txns + interactive "
+                        "barriers/sync points; zipf = hot-key skew with a "
+                        "mid-burn hot-range migration; openloop = Poisson "
+                        "arrivals at --rate txn/s of sim-time (pair with "
+                        "--burnrate: zero slo.burn events = rate sustained). "
+                        "Default: the classic uniform closed-loop mix")
+    p.add_argument("--rate", type=float, default=25.0, metavar="TXN_S",
+                   help="openloop arrival rate, txn per sim-second "
+                        "(default 25)")
+    p.add_argument("--parallel-seeds", type=int, default=0, metavar="N",
+                   help="run the seed range across N worker processes "
+                        "(spawn pool; observers/artifacts stay off in "
+                        "workers) and ledger one cohort record to "
+                        "BENCH_HISTORY.jsonl")
     p.add_argument("--json", default=None, metavar="PATH",
                    help="write a machine-readable per-seed summary "
                         "(pass/stall/divergence, wall-clock, ops resolved, "
@@ -1095,7 +1303,9 @@ def main(argv=None) -> None:
                    "journal_injected_tears", "journal_injected_bitflips",
                    "journal_torn_records", "journal_quarantined_txns",
                    "node_joins", "node_decommissions")
-    for seed in seeds:
+    def base_kw(seed: int):
+        """Seeded per-seed run_burn kwargs — shared by the inline loop and
+        the --parallel-seeds pool, so everything here must stay picklable."""
         if args.matrix == "big":
             # the large-cluster regime: 10-20 nodes, rf 3/5, seeded per seed
             srng = RandomSource(seed)
@@ -1121,8 +1331,61 @@ def main(argv=None) -> None:
                   disk_stall=not args.no_disk_stall,
                   stall_watchdog_s=watchdog_s,
                   columnar=args.columnar,
+                  check=args.check,
+                  workload=args.workload,
+                  rate_txn_s=args.rate,
                   node_config=cfg,
                   max_tasks=200_000_000)
+        return rf, kw
+
+    if args.parallel_seeds > 1:
+        if args.reconcile:
+            raise SystemExit("--parallel-seeds does not compose with "
+                             "--reconcile (run the sweep, replay failed "
+                             "seeds singly)")
+        if (args.metrics_out or args.trace_out or args.profile
+                or args.timeline_out):
+            print("warning: per-seed artifacts are skipped under "
+                  "--parallel-seeds (workers run observer-free)", flush=True)
+        import multiprocessing as _mp
+        t0 = _time.perf_counter()
+        jobs = []
+        for seed in seeds:
+            _rf, kw = base_kw(seed)
+            if args.audit != "off":
+                # run_burn constructs its own auditor per worker; the mode
+                # string is picklable where an InvariantAuditor is not
+                kw["audit"] = args.audit
+                kw["audit_slo_s"] = args.audit_slo
+            jobs.append((seed, kw))
+        ctx = _mp.get_context("spawn")   # no inherited simulator/device state
+        with ctx.Pool(processes=args.parallel_seeds) as pool:
+            results = pool.starmap(_sweep_worker, jobs)
+        wall = round(_time.perf_counter() - t0, 3)
+        summaries.extend(results)
+        n_pass = sum(1 for r in results if r["status"] == "pass")
+        _append_trend({"kind": "burn_sweep", "metric": "sweep_wall_s",
+                       "value": wall, "unit": "s",
+                       "seeds": [int(s) for s in seeds], "ops": args.ops,
+                       "workers": args.parallel_seeds,
+                       "workload": args.workload, "check": args.check,
+                       "audit": args.audit, "benign": bool(args.benign),
+                       "passed": n_pass,
+                       "failed": len(results) - n_pass})
+        write_json()
+        for r in results:
+            line = f"seed {r['seed']}: {r['status']} ({r['wall_s']}s)"
+            if r["status"] != "pass":
+                line += f" — {r.get('error', '')[:200]}"
+            print(line, flush=True)
+        print(f"sweep: {n_pass}/{len(results)} passed in {wall}s "
+              f"({args.parallel_seeds} workers)", flush=True)
+        if n_pass != len(results):
+            raise SystemExit(1)
+        return
+
+    for seed in seeds:
+        rf, kw = base_kw(seed)
         observer = None
         # per-seed trajectory planes: windowed sim-time telemetry
         # (--timeline-out) and the multi-window SLO burn-rate monitors
@@ -1238,6 +1501,29 @@ def main(argv=None) -> None:
                 if getattr(result, "audit", None) is not None:
                     # per-seed audit verdict: violations + SLO flags
                     entry["audit"] = result.audit
+                if getattr(result, "history", None) is not None:
+                    # the oracle's clean-run summary (op/edge counts); any
+                    # anomaly would have raised HistoryAnomaly instead
+                    entry["history"] = {k: result.history[k]
+                                        for k in ("ops", "ok", "keys",
+                                                  "edges")}
+                if args.workload == "openloop" and monitor is not None:
+                    # the open-loop SLO preset's verdict: sustained = the
+                    # arrival rate was held for the whole burn with zero
+                    # slo.burn events — ledgered as the workload_slo series
+                    rep = monitor.report()
+                    events = rep.get("slo_burn_events", 0)
+                    slo_rec = {"kind": "workload_slo",
+                               "metric": "slo_burn_events", "value": events,
+                               "slo_burn_events": events,
+                               "unit": "events", "workload": "openloop",
+                               "seeds": [seed], "ops": args.ops,
+                               "rate_txn_s": args.rate,
+                               "sim_minutes": round(
+                                   result.sim_micros / 60e6, 2),
+                               "sustained": events == 0}
+                    _append_trend(slo_rec)
+                    entry["workload_slo"] = slo_rec
                 profile_reports(entry)
                 write_artifacts()
                 write_json()
@@ -1245,8 +1531,11 @@ def main(argv=None) -> None:
                       f"{_time.perf_counter() - t0:.1f}s)")
         except SimulationException as e:
             from ..observe.audit import AuditViolation
+            from ..observe.checker import HistoryAnomaly
             if isinstance(e.cause, AuditViolation):
                 status = "audit_violation"
+            elif isinstance(e.cause, HistoryAnomaly):
+                status = "history_anomaly"
             elif isinstance(e.cause, StallError):
                 status = "stall"
             elif isinstance(e.cause, HistoryViolation) \
@@ -1257,6 +1546,10 @@ def main(argv=None) -> None:
             entry.update(status=status,
                          wall_s=round(_time.perf_counter() - t0, 3),
                          error=str(e.cause)[:2000])
+            if isinstance(e.cause, HistoryAnomaly):
+                # the structured report (named anomalies + sub-histories +
+                # flight-recorder timelines) for machine diffing
+                entry["history"] = e.cause.report
             if e.audit is not None:
                 entry["audit"] = e.audit
             # the flight recording is MOST valuable on a failed seed: write

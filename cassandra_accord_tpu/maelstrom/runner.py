@@ -120,45 +120,83 @@ class MaelstromCluster:
 
 
 def run_workload(seed: int, n_nodes: int = 3, ops: int = 50,
-                 partition_interval_s: Optional[float] = 2.0) -> Dict:
+                 partition_interval_s: Optional[float] = 2.0,
+                 check: bool = True) -> Dict:
     """Seeded list-append workload (SimpleRandomTest): every txn must eventually
     get txn_ok (retrying on error/timeout), and every read must observe a
-    prefix-consistent list per key."""
+    prefix-consistent list per key.
+
+    With ``check=True`` the adapter also records the full client-visible
+    history (every attempt: an errored attempt may still have committed, so
+    it is recorded as an info op and the retry uses FRESH values — reusing
+    values would break the unique-write attribution the checker relies on),
+    reads back every touched key for an authoritative final state, and runs
+    the protocol-blind oracle (observe/checker.py) over it — the Elle-style
+    cross-check of the Maelstrom path (ROADMAP item 4d)."""
+    from ..observe.checker import check_history
+    from ..observe.history import HistoryRecorder
     cluster = MaelstromCluster(n_nodes, seed=seed,
                                partition_interval_s=partition_interval_s)
     rng = RandomSource(seed * 31 + 1)
+    history = HistoryRecorder()
     results: Dict[int, dict] = {}
-    state = {"msg": 0, "done": 0, "submitted": 0}
+    state = {"msg": 0, "done": 0, "submitted": 0, "val": 0}
     pending: Dict[int, tuple] = {}
+    touched: set = set()
 
-    def submit(op_id: int, ops_list: List[list]) -> None:
+    def submit(op_id: int, shape: List[tuple], attempt: int = 0) -> None:
         state["msg"] += 1
         msg_id = state["msg"]
-        pending[msg_id] = (op_id, ops_list)
+        ops_list: List[list] = []
+        reads: List[int] = []
+        writes: Dict[int, list] = {}
+        for kind, key in shape:
+            if kind == "r":
+                ops_list.append(["r", key, None])
+                reads.append(key)
+            else:
+                state["val"] += 1
+                v = state["val"]
+                ops_list.append(["append", key, v])
+                writes.setdefault(key, []).append(v)
+                touched.add(key)
+        pending[msg_id] = (op_id, shape, attempt)
+        hid = (op_id, attempt)
+        history.invoke(hid, None, cluster.queue.now_micros,
+                       tuple(reads), writes)
 
-        def handler(packet: dict, _msg_id=msg_id) -> None:
-            op_id2, ops2 = pending.pop(_msg_id)
+        def handler(packet: dict, _msg_id=msg_id, _hid=hid,
+                    _writes=writes) -> None:
+            op_id2, shape2, attempt2 = pending.pop(_msg_id)
             body = packet["body"]
+            now = cluster.queue.now_micros
             if body["type"] == "txn_ok":
+                observed = {k: tuple(v or ()) for op, k, v in body["txn"]
+                            if op == "r"}
+                history.resolve(_hid, "ok", now, reads=observed,
+                                writes=_writes)
                 results[op_id2] = body
                 state["done"] += 1
             else:
-                # retry on a (possibly different) node — client-side liveness
-                # (ListRequest retry semantics)
-                submit(op_id2, ops2)
+                # outcome unknown — the txn may still have committed: an
+                # info op, then retry on a (possibly different) node with
+                # fresh values — client-side liveness (ListRequest retry
+                # semantics)
+                history.resolve(_hid, "lost", now)
+                submit(op_id2, shape2, attempt2 + 1)
 
         to = f"n{1 + rng.next_int(n_nodes)}"
         cluster.submit_txn(to, ops_list, msg_id, handler)
 
     for i in range(ops):
         key = rng.next_int(8)
-        ops_list = []
+        shape: List[tuple] = []
         if rng.next_boolean():
-            ops_list.append(["r", key, None])
-        ops_list.append(["append", key, i])
+            shape.append(("r", key))
+        shape.append(("append", key))
         if rng.next_float() < 0.3:
-            ops_list.append(["append", rng.next_int(8), 1000 + i])
-        submit(i, ops_list)
+            shape.append(("append", rng.next_int(8)))
+        submit(i, shape)
         state["submitted"] += 1
 
     ok = cluster.run_until(lambda: state["done"] >= ops, max_tasks=3_000_000)
@@ -175,4 +213,43 @@ def run_workload(seed: int, n_nodes: int = 3, ops: int = 50,
             assert longer[: len(shorter)] == shorter, \
                 f"non-prefix reads on {key}: {prev} vs {value}"
             longest[key] = longer
-    return {"ok": state["done"], "reads_checked": sum(len(v) for v in longest.values())}
+    out = {"ok": state["done"],
+           "reads_checked": sum(len(v) for v in longest.values())}
+
+    if check:
+        # authoritative final state: read back every touched key (retrying
+        # through partitions), then hand the whole history to the oracle
+        final_state: Dict[int, tuple] = {}
+
+        def read_back(key: int, attempt: int = 0) -> None:
+            state["msg"] += 1
+            msg_id = state["msg"]
+            hid = ("final", key, attempt)
+            history.invoke(hid, None, cluster.queue.now_micros, (key,), None)
+
+            def handler(packet: dict, _hid=hid, _key=key,
+                        _attempt=attempt) -> None:
+                body = packet["body"]
+                now = cluster.queue.now_micros
+                if body["type"] == "txn_ok":
+                    val = tuple(body["txn"][0][2] or ())
+                    history.resolve(_hid, "ok", now, reads={_key: val})
+                    final_state[_key] = val
+                else:
+                    history.resolve(_hid, "lost", now)
+                    read_back(_key, _attempt + 1)
+
+            to = f"n{1 + rng.next_int(n_nodes)}"
+            cluster.submit_txn(to, [["r", key, None]], msg_id, handler)
+
+        for key in sorted(touched):
+            read_back(key)
+        ok2 = cluster.run_until(
+            lambda: len(final_state) >= len(touched), max_tasks=3_000_000)
+        assert ok2, f"final-state read-back stalled: " \
+                    f"{len(final_state)}/{len(touched)} keys"
+        report = check_history(history.ops, final_state=final_state)
+        out["history_ops"] = len(history)
+        out["final_keys"] = len(final_state)
+        out["history"] = {k: report[k] for k in ("ops", "ok", "keys", "edges")}
+    return out

@@ -25,6 +25,8 @@ from .profiler import WallProfiler, format_wall_profile
 from .timeline import (Timeline, commits_per_sec_series, exact_percentile,
                        write_timeline_jsonl)
 from .burnrate import BurnRateMonitor, SloSpec
+from .history import HistoryOp, HistoryRecorder
+from .checker import HistoryAnomaly, check_history, format_report
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -37,4 +39,6 @@ __all__ = [
     "Timeline", "commits_per_sec_series", "exact_percentile",
     "write_timeline_jsonl",
     "BurnRateMonitor", "SloSpec",
+    "HistoryOp", "HistoryRecorder",
+    "HistoryAnomaly", "check_history", "format_report",
 ]

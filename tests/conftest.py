@@ -30,6 +30,10 @@ def pytest_configure(config):
     # importing conftest as a module from a test binds a SECOND module
     # instance (tests/ is not a package) with its own stamp.
     config._accord_session_t0 = time.monotonic()
+    # the tier-1 selection runs `-m 'not slow'`: hours-class burns (the
+    # ACCORD_LONG_BURNS acceptance matrices, soak presets) carry this mark
+    config.addinivalue_line(
+        "markers", "slow: hours-class burns excluded from the tier-1 run")
 
 
 @pytest.fixture

@@ -1,0 +1,249 @@
+"""Independent history oracle (ISSUE-16 tentpole): the Elle-style checker.
+
+Three planes:
+1. MUTATION tests — hand-injected anomalous histories (stale read /
+   real-time violation, lost update, G1c, G0, fractured read, aborted read,
+   incompatible order) must each be caught and NAMED; a checker that only
+   ever says "clean" is not an oracle.
+2. CLEAN-matrix — full hostile burns under ``check="history"`` (composable
+   with ``audit="strict"``) pass with zero anomalies; seeds 0-9 x 250 ops
+   behind ACCORD_LONG_BURNS.
+3. ZERO OBSERVER EFFECT — same-seed hostile burn with history recording on
+   vs off is byte-identical (full trace diff + audit verdict + outcomes),
+   the same proof pattern as the PR 3/10/12 observability planes.
+"""
+import os
+
+import pytest
+
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+from cassandra_accord_tpu.observe.checker import (HistoryAnomaly,
+                                                  check_history,
+                                                  format_report)
+from cassandra_accord_tpu.observe.history import HistoryRecorder
+
+HOSTILE = dict(ops=40, concurrency=8, chaos=True, allow_failures=True,
+               durability=True, journal=True, delayed_stores=True,
+               clock_drift=True, max_tasks=3_000_000)
+
+
+# ---------------------------------------------------------------------------
+# mutation tests: injected anomalies must be caught and named
+# ---------------------------------------------------------------------------
+
+def _anomaly(rec, final_state=None):
+    with pytest.raises(HistoryAnomaly) as exc:
+        check_history(rec.ops, final_state=final_state)
+    return exc.value.report["anomalies"][0]
+
+
+def test_stale_read_is_a_realtime_violation():
+    # op2 is invoked strictly AFTER op1's write completed, yet observes an
+    # empty list: serializable (op2 before op1) but not STRICTLY so
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"k": "a"})
+    rec.resolve(1, "ok", 100, writes={"k": "a"})
+    rec.invoke(2, "t2", 200, ("k",))
+    rec.resolve(2, "ok", 300, reads={"k": ()})
+    a = _anomaly(rec, final_state={"k": ("a",)})
+    assert a["name"] == "G-single-realtime"
+    assert "stale read" in a["detail"]
+    kinds = {e["kind"] for e in a["edges"]}
+    assert kinds == {"rw", "rt"}
+
+
+def test_stale_read_caught_without_final_state():
+    # the hardest stale-read shape: the committed write's value never
+    # surfaces in ANY observation and no final state pins its position —
+    # but a read returns the ENTIRE list, so an acked append absent from a
+    # later read's list is an rw edge regardless of position knowledge.
+    # (Found by probing the package boundary: the positional rw table
+    # alone cannot see writers the version order never named.)
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"k": "a"})
+    rec.resolve(1, "ok", 100, writes={"k": "a"})
+    rec.invoke(2, "t2", 200, ("k",))
+    rec.resolve(2, "ok", 300, reads={"k": ()})
+    a = _anomaly(rec)   # NO final_state
+    assert a["name"] == "G-single-realtime"
+    assert {e["kind"] for e in a["edges"]} == {"rw", "rt"}
+
+
+def test_lost_update_caught():
+    # an acked write whose value never made the authoritative final order
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"k": "a"})
+    rec.resolve(1, "ok", 100, writes={"k": "a"})
+    a = _anomaly(rec, final_state={"k": ("b",)})
+    assert a["name"] == "lost-update"
+    assert "missing from final order" in a["detail"]
+    # key entirely absent from the final state is the same anomaly
+    rec2 = HistoryRecorder()
+    rec2.invoke(1, "t1", 0, (), {"k": "a"})
+    rec2.resolve(1, "ok", 100, writes={"k": "a"})
+    assert _anomaly(rec2, final_state={})["name"] == "lost-update"
+
+
+def test_g1c_circular_information_flow():
+    # op1 writes x and observes op2's y; op2 writes y and observes op1's x —
+    # each read the other's write: no serial order exists.  Overlapping
+    # intervals, so the cycle closes WITHOUT real-time edges.
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, ("y",), {"x": "a"})
+    rec.invoke(2, "t2", 0, ("x",), {"y": "b"})
+    rec.resolve(1, "ok", 1000, reads={"y": ("b",)}, writes={"x": "a"})
+    rec.resolve(2, "ok", 1000, reads={"x": ("a",)}, writes={"y": "b"})
+    a = _anomaly(rec)
+    assert a["name"] == "G1c"
+    assert {e["kind"] for e in a["edges"]} == {"wr"}
+
+
+def test_g0_write_cycle():
+    # ww-only cycle: the version orders interleave the two writers' keys in
+    # opposite orders
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"x": "a1", "y": "b2"})
+    rec.invoke(2, "t2", 0, (), {"x": "a2", "y": "b1"})
+    rec.resolve(1, "ok", 1000, writes={"x": "a1", "y": "b2"})
+    rec.resolve(2, "ok", 1000, writes={"x": "a2", "y": "b1"})
+    a = _anomaly(rec, final_state={"x": ("a1", "a2"), "y": ("b1", "b2")})
+    assert a["name"] == "G0"
+    assert {e["kind"] for e in a["edges"]} == {"ww"}
+
+
+def test_fractured_read_named_non_repeatable():
+    # op2 observes HALF of op1's atomic two-key write
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"x": "a", "y": "b"})
+    rec.invoke(2, "t2", 0, ("x", "y"))
+    rec.resolve(1, "ok", 1000, writes={"x": "a", "y": "b"})
+    rec.resolve(2, "ok", 1000, reads={"x": ("a",), "y": ()})
+    a = _anomaly(rec, final_state={"x": ("a",), "y": ("b",)})
+    assert a["name"] == "non-repeatable-read"
+    assert "fractured read" in a["detail"]
+
+
+def test_aborted_read_g1a():
+    # an op the cluster durably NACKED must never surface to a reader
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"x": "a"})
+    rec.resolve(1, "nacked", 100, writes={"x": "a"})
+    rec.invoke(2, "t2", 200, ("x",))
+    rec.resolve(2, "ok", 300, reads={"x": ("a",)})
+    a = _anomaly(rec)
+    assert a["name"] == "G1a-aborted-read"
+
+
+def test_incompatible_order():
+    # list-append reads must be prefixes of one another
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, ("x",))
+    rec.resolve(1, "ok", 100, reads={"x": ("a", "b")})
+    rec.invoke(2, "t2", 0, ("x",))
+    rec.resolve(2, "ok", 100, reads={"x": ("a", "c")})
+    a = _anomaly(rec)
+    assert a["name"] == "incompatible-order"
+
+
+def test_info_op_writes_may_surface_cleanly():
+    # a lost op's writes MAY apply: surfacing is not an anomaly, and the
+    # writer joins the graph for attribution
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"x": "a"})
+    rec.resolve(1, "lost", 100)
+    rec.invoke(2, "t2", 200, ("x",))
+    rec.resolve(2, "ok", 300, reads={"x": ("a",)})
+    report = check_history(rec.ops, final_state={"x": ("a",)})
+    assert report["anomalies"] == []
+    assert report["edges"]["wr"] == 1
+
+
+def test_clean_history_reports_clean():
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"x": "a"})
+    rec.resolve(1, "ok", 100, writes={"x": "a"})
+    rec.invoke(2, "t2", 200, ("x",))
+    rec.resolve(2, "ok", 300, reads={"x": ("a",)})
+    report = check_history(rec.ops, final_state={"x": ("a",)})
+    assert report["anomalies"] == []
+    assert report["ok"] == 2 and report["keys"] == 1
+
+
+# ---------------------------------------------------------------------------
+# report content: sub-history, edges, flight-recorder timelines
+# ---------------------------------------------------------------------------
+
+def test_report_carries_sub_history_and_timelines():
+    class _Span:
+        def to_dict(self):
+            return {"events": ["PreAccept", "Commit"]}
+
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"k": "a"})
+    rec.resolve(1, "ok", 100, writes={"k": "a"})
+    rec.invoke(2, "t2", 200, ("k",))
+    rec.resolve(2, "ok", 300, reads={"k": ()})
+    with pytest.raises(HistoryAnomaly) as exc:
+        check_history(rec.ops, final_state={"k": ("a",)},
+                      spans={"t1": _Span(), "t2": _Span()})
+    a = exc.value.report["anomalies"][0]
+    ids = {r["op_id"] for r in a["sub_history"]}
+    assert ids == {1, 2}
+    assert set(a["timelines"]) == {"t1", "t2"}
+    text = format_report(exc.value.report)
+    assert "G-single-realtime" in text and "op 1" in text
+    assert "timelines attached" in text
+
+
+# ---------------------------------------------------------------------------
+# burn integration: hostile matrix clean under check="history"
+# ---------------------------------------------------------------------------
+
+def test_hostile_burn_checks_clean():
+    res = run_burn(5, check="history", **HOSTILE)
+    assert res.history is not None
+    assert res.history["anomalies"] == []
+    assert res.history["ops"] >= res.ops_ok
+
+
+def test_history_composes_with_strict_audit():
+    # both oracles at once: the protocol-aware auditor AND the protocol-
+    # blind checker over the identical trajectory
+    res = run_burn(7, check="history", audit="strict", **HOSTILE)
+    assert res.history is not None and res.history["anomalies"] == []
+    assert res.audit is not None and not res.audit.get("violations")
+
+
+def test_zero_observer_effect_history_recording():
+    # the recorder is a passive sink: same-seed hostile burns with history
+    # recording on vs off are byte-identical in the FULL message trace, the
+    # audit verdict, and the outcome partition
+    ta, tb = Trace(), Trace()
+    bare = run_burn(9, tracer=ta.hook, audit="warn", **HOSTILE)
+    checked = run_burn(9, tracer=tb.hook, audit="warn", check="history",
+                       **HOSTILE)
+    assert diff_traces(ta, tb) is None
+    assert (bare.ops_ok, bare.ops_recovered, bare.ops_nacked,
+            bare.ops_lost, bare.ops_failed) == \
+           (checked.ops_ok, checked.ops_recovered, checked.ops_nacked,
+            checked.ops_lost, checked.ops_failed)
+    assert bare.audit == checked.audit
+    assert checked.history is not None
+
+
+@pytest.mark.slow
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="hours-class: the full acceptance matrix")
+def test_full_matrix_seeds_0_9_clean():
+    # the ISSUE-16 acceptance matrix: hostile + churn + elastic, seeds 0-9 x
+    # 250 ops, BOTH oracles on — zero violations, zero anomalies
+    for seed in range(10):
+        res = run_burn(seed, ops=250, concurrency=16, chaos=True,
+                       allow_failures=True, durability=True, journal=True,
+                       delayed_stores=True, clock_drift=True,
+                       topology_churn=True, elastic_membership=True,
+                       restart_nodes=True, pause_nodes=True, disk_stall=True,
+                       check="history", audit="strict",
+                       max_tasks=100_000_000)
+        assert res.history is not None and res.history["anomalies"] == []

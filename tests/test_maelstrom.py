@@ -133,12 +133,18 @@ def test_parse_txn_multi_append():
 def test_runner_benign_network():
     out = run_workload(1, n_nodes=3, ops=40, partition_interval_s=None)
     assert out["ok"] == 40
+    # the Elle-style cross-check ran end-to-end over the adapter's history
+    # (every attempt recorded + final-state read-back); an anomaly raises
+    assert out["history"]["ops"] == out["history_ops"]
+    assert out["final_keys"] >= 1
+    assert out["history"]["edges"]["ww"] + out["history"]["edges"]["wr"] > 0
 
 
 def test_runner_with_partitions():
     for seed in (2, 9):
         out = run_workload(seed, n_nodes=5, ops=40, partition_interval_s=1.5)
         assert out["ok"] == 40
+        assert out["history"]["ops"] >= 40   # retries add info ops
 
 
 # ---------------------------------------------------------------------------

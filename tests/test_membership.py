@@ -240,3 +240,25 @@ def test_elastic_gray_failure_burn():
                       max_tasks=80_000_000)
     assert result.resolved == 80
     assert result.joins + result.leaves >= 1, result
+
+
+def test_seed8_unknown_epoch_probe_regression():
+    """Round-13 find (flushed by the seeds 0-9 x 250-op acceptance matrix
+    under --elastic): a replica can learn of a blocked txn through
+    deps/inform traffic BEFORE its config service delivers the txn's epoch.
+    The progress log then escalated to fetch_data -> check_status_quorum,
+    whose direct `precise_epochs(route, epoch, epoch)` call threw
+    "epochs [10,10] not all known" and killed the burn.  The fix gates the
+    probe on `node.with_epoch(txn_id.epoch)` (FetchData.java's withEpoch
+    wrap) — synchronous when the epoch is known, so established
+    trajectories are byte-identical.  This is the verbatim crash shape at
+    the smallest reproducing op count."""
+    rf = 2 + RandomSource(8).next_int(8)   # mirror the burn CLI's seeded rf
+    result = run_burn(8, ops=150, concurrency=20, rf=rf, chaos=True,
+                      allow_failures=True, topology_churn=True,
+                      elastic_membership=True, durability=True, journal=True,
+                      delayed_stores=True, clock_drift=True, cache_miss=True,
+                      restart_nodes=True, pause_nodes=True, disk_stall=True,
+                      audit="strict", max_tasks=200_000_000)
+    assert result.resolved == 150
+    assert result.joins + result.leaves >= 1, result

@@ -1,0 +1,164 @@
+"""Workload presets (ISSUE-16): multi-range/interactive, Zipf-with-migration,
+open-loop Poisson — plus the --parallel-seeds sweep runner.
+
+Every preset runs under the hostile matrix with the history oracle on: the
+new traffic shapes must not just execute, they must check clean against a
+protocol-blind second opinion.  Heavy presets (10k-op Zipf, open-loop soak)
+are gated behind ACCORD_LONG_BURNS.
+"""
+import json
+import os
+
+import pytest
+
+from cassandra_accord_tpu.harness.burn import main as burn_main
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.workload import (MultiRangeWorkload,
+                                                   OpenLoopWorkload,
+                                                   ZipfWorkload,
+                                                   make_workload)
+
+HOSTILE = dict(chaos=True, allow_failures=True, durability=True,
+               journal=True, delayed_stores=True, clock_drift=True,
+               max_tasks=20_000_000)
+
+
+def test_make_workload_rejects_unknown():
+    with pytest.raises(ValueError):
+        make_workload("bogus")
+    w = OpenLoopWorkload(rate_txn_s=10.0)
+    assert make_workload(w) is w   # instances pass through
+
+
+def test_multirange_hostile_with_interactive_ops():
+    # cross-shard txns + barriers + sync points through the coordinate
+    # surface, under chaos + churn + elastic membership, history-checked
+    w = MultiRangeWorkload()
+    res = run_burn(1, ops=80, concurrency=10, topology_churn=True,
+                   elastic_membership=True, check="history", workload=w,
+                   **HOSTILE)
+    assert res.resolved == 80
+    assert res.history is not None and res.history["anomalies"] == []
+    # the preset actually generated every op class it advertises
+    assert w.counts.get("multirange_txn", 0) > 0
+    assert w.counts.get("range_read", 0) > 0
+    assert w.counts.get("barrier", 0) + w.counts.get("sync_point", 0) > 0
+
+
+def test_zipf_migration_moves_the_hot_range():
+    w = ZipfWorkload()
+    res = run_burn(2, ops=120, concurrency=10, check="history", workload=w,
+                   **HOSTILE)
+    assert res.resolved == 120
+    assert res.history is not None and res.history["anomalies"] == []
+    assert w.counts.get("post_migration", 0) > 0
+    # forensics: the modal hot index must MOVE at the migration point
+    cut = int(120 * w.migrate_at)
+    pre = [idx for op_id, idx in w.key_log if op_id < cut]
+    post = [idx for op_id, idx in w.key_log if op_id >= cut]
+    assert pre and post
+    mode = lambda xs: max(set(xs), key=xs.count)   # noqa: E731
+    assert mode(pre) != mode(post)
+
+
+def test_openloop_sustains_rate_with_zero_slo_burn():
+    # the PR-10 burn-rate monitors as the pass/fail oracle: at a modest
+    # arrival rate the hostile matrix must hold the SLO with zero burns
+    from cassandra_accord_tpu.observe import BurnRateMonitor, InvariantAuditor
+    monitor = BurnRateMonitor()
+    auditor = InvariantAuditor(mode="warn", burnrate=monitor)
+    res = run_burn(3, ops=100, concurrency=8, workload="openloop",
+                   rate_txn_s=30.0, check="history", observer=auditor,
+                   audit="warn", **HOSTILE)
+    assert res.resolved == 100
+    assert res.history is not None and res.history["anomalies"] == []
+    assert monitor.report()["slo_burn_events"] == 0
+
+
+def test_openloop_is_deterministic():
+    kw = dict(ops=60, concurrency=8, workload="openloop", rate_txn_s=40.0,
+              **HOSTILE)
+    a = run_burn(4, **kw)
+    b = run_burn(4, **kw)
+    assert a.sim_micros == b.sim_micros
+    assert (a.ops_ok, a.ops_recovered, a.ops_nacked, a.ops_lost,
+            a.ops_failed) == (b.ops_ok, b.ops_recovered, b.ops_nacked,
+                              b.ops_lost, b.ops_failed)
+
+
+def test_workload_off_stays_byte_identical():
+    # workload=None leaves the classic generator untouched: the new hooks
+    # must not perturb a single RNG draw on existing seeds
+    from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+    kw = dict(ops=30, concurrency=6, chaos=True, allow_failures=True,
+              durability=True, journal=True, max_tasks=3_000_000)
+    ta, tb = Trace(), Trace()
+    run_burn(11, tracer=ta.hook, **kw)
+    run_burn(11, tracer=tb.hook, workload=None, **kw)
+    assert diff_traces(ta, tb) is None
+
+
+def test_parallel_seeds_cli_sweep(tmp_path, monkeypatch):
+    # the process-pool sweep: 3 seeds across 2 spawn workers, one cohort
+    # record in the ledger, per-seed entries in --json
+    ledger = tmp_path / "history.jsonl"
+    out = tmp_path / "sweep.json"
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", str(ledger))
+    burn_main(["--seeds", "0:2", "--ops", "20", "--concurrency", "6",
+               "--parallel-seeds", "2", "--check", "history",
+               "--json", str(out)])
+    doc = json.loads(out.read_text())
+    assert len(doc["results"]) == 3
+    assert all(r["status"] == "pass" for r in doc["results"])
+    assert all(r["history"]["ops"] >= 1 for r in doc["results"])
+    records = [json.loads(l) for l in ledger.read_text().splitlines()]
+    cohort = [r for r in records if r["kind"] == "burn_sweep"]
+    assert len(cohort) == 1
+    assert cohort[0]["seeds"] == [0, 1, 2]
+    assert cohort[0]["passed"] == 3 and cohort[0]["failed"] == 0
+    assert cohort[0]["workers"] == 2
+
+
+def test_openloop_cli_ledgers_workload_slo(tmp_path, monkeypatch):
+    ledger = tmp_path / "history.jsonl"
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", str(ledger))
+    burn_main(["--seeds", "0", "--ops", "40", "--workload", "openloop",
+               "--rate", "30", "--burnrate", "--check", "history"])
+    records = [json.loads(l) for l in ledger.read_text().splitlines()]
+    slo = [r for r in records if r["kind"] == "workload_slo"]
+    assert len(slo) == 1
+    assert slo[0]["workload"] == "openloop"
+    assert slo[0]["rate_txn_s"] == 30.0
+    assert slo[0]["sustained"] is True
+    assert slo[0]["slo_burn_events"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="hours-class: soak presets")
+def test_zipf_soak_10k_ops():
+    w = ZipfWorkload()
+    res = run_burn(0, ops=10_000, concurrency=24, topology_churn=True,
+                   elastic_membership=True, check="history", workload=w,
+                   chaos=True, allow_failures=True, durability=True,
+                   journal=True, delayed_stores=True, clock_drift=True,
+                   restart_nodes=True, pause_nodes=True, disk_stall=True,
+                   max_tasks=500_000_000)
+    assert res.resolved == 10_000
+    assert res.history is not None and res.history["anomalies"] == []
+
+
+@pytest.mark.slow
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="hours-class: soak presets")
+def test_openloop_soak_sustained():
+    from cassandra_accord_tpu.observe import BurnRateMonitor, InvariantAuditor
+    monitor = BurnRateMonitor()
+    auditor = InvariantAuditor(mode="warn", burnrate=monitor)
+    res = run_burn(1, ops=5_000, concurrency=24, workload="openloop",
+                   rate_txn_s=40.0, check="history", observer=auditor,
+                   audit="warn", chaos=True, allow_failures=True,
+                   durability=True, journal=True, delayed_stores=True,
+                   clock_drift=True, max_tasks=500_000_000)
+    assert res.resolved == 5_000
+    assert monitor.report()["slo_burn_events"] == 0

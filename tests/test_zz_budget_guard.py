@@ -12,7 +12,11 @@ while there is still margin to act on.
 The threshold leaves headroom below the 870s ceiling for collection,
 interpreter startup, and machine variance; the measured post-round-9
 baseline is ~230-260s (seed baseline 207s + the seed-6 regression burn and
-the membership suite).
+the membership suite).  Round-13 headroom re-check: the history-checker +
+workload + maelstrom-cross-check additions cost ~35s (mutation tests are
+milliseconds; the hostile-burn integration tests and the spawn-pool sweep
+dominate), with the soak presets (10k-op Zipf, open-loop soak, the seeds
+0-9 acceptance matrix) gated behind ACCORD_LONG_BURNS + ``-m 'not slow'``.
 """
 import os
 import time

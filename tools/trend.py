@@ -224,6 +224,28 @@ def trend_lines(entries: List[dict], last_k: int = 8,
             if isinstance(er, dict) and er:
                 lines.append("  deps_graph.exec_commit_rate  "
                              + " ".join(f"{k}={v}" for k, v in er.items()))
+    # the workload_slo series (ISSUE-16 open-loop preset): did the run
+    # sustain its arrival rate — rendered per (workload, rate) cohort so a
+    # rate change never reads as a regression.  Sources: bench.py's
+    # workload_slo stage records embed a dict; the burn CLI's openloop runs
+    # append standalone kind=workload_slo records.
+    def _wslo(e):
+        if isinstance(e.get("workload_slo"), dict):
+            return e["workload_slo"]
+        if e.get("kind") == "workload_slo":
+            return e
+        return None
+    ws_present = [(e, w) for e in window if (w := _wslo(e)) is not None]
+    if ws_present:
+        latest_w = ws_present[-1][1]
+        rate_cohort = (latest_w.get("workload"), latest_w.get("rate_txn_s"))
+        same = [w for _e, w in ws_present
+                if (w.get("workload"), w.get("rate_txn_s")) == rate_cohort]
+        parts = [f"{'sustained' if w.get('sustained') else 'BURNED'}"
+                 f"({w.get('slo_burn_events', w.get('value'))} ev"
+                 f"/{w.get('sim_minutes')}min)" for w in same]
+        lines.append(f"  workload_slo@{rate_cohort[0]}:"
+                     f"{rate_cohort[1]}txn/s     " + " -> ".join(parts))
     # the protocol-throughput series: delta arrows across runs recording the
     # same ramp levels (a different concurrency ceiling is a different
     # measurement, like a different seed cohort)
