@@ -678,6 +678,20 @@ def emit_and_exit(code=0):
                     "slo_burn_events": wslo.get("slo_burn_events"),
                     "sustained": wslo.get("sustained"),
                 }
+            ov = RESULT["detail"].get("overload")
+            if ov:
+                # the overload series tools/trend.py renders: goodput floor
+                # under the admission-controlled metastability ramp
+                record["overload"] = {
+                    "mode": ov.get("mode"),
+                    "rate_txn_s": ov.get("rate_txn_s"),
+                    "capacity_goodput_txn_s":
+                        ov.get("capacity_goodput_txn_s"),
+                    "goodput_floor_frac": ov.get("goodput_floor_frac"),
+                    "shed": sum(p.get("shed", 0)
+                                for p in ov.get("points", [])),
+                    "passed": ov.get("passed"),
+                }
             # the seed cohort keys run-over-run comparability in
             # tools/trend.py — a bench smoke record and a perfgate record
             # of the same seed are the same measurement
@@ -950,6 +964,33 @@ def main():
     ws = stage("workload_slo", workload_slo)
     if ws is not None:
         d["workload_slo"] = ws
+
+    def overload():
+        # overload-robustness cohort (ISSUE-17): a small metastability ramp
+        # (0.5x/1x/2x of the target rate, admission control + retry budgets
+        # on) under the hostile matrix — the bench ledgers the goodput floor
+        # fraction and capacity estimate run-over-run so a metastable
+        # regression (goodput cratering past saturation) shows in trend.py
+        from dataclasses import replace
+        from cassandra_accord_tpu.config import LocalConfig
+        from cassandra_accord_tpu.harness.burn import run_overload_ramp
+
+        rate = 30.0
+        cfg = replace(LocalConfig.from_env(), admission_enabled=True,
+                      retry_budget_enabled=True)
+        kw = dict(ops=120, concurrency=PROTO_CONC, chaos=True,
+                  allow_failures=True, durability=True, journal=True,
+                  delayed_stores=True, clock_drift=True, workload="openloop",
+                  node_config=cfg, check="history", audit="warn",
+                  stall_watchdog_s=300.0, max_tasks=80_000_000)
+        t0 = time.perf_counter()
+        out = run_overload_ramp(PROTO_SEED, kw, rate, mults=(0.5, 1.0, 2.0))
+        out["wall_s"] = round(time.perf_counter() - t0, 2)
+        return out
+
+    ov = stage("overload", overload)
+    if ov is not None:
+        d["overload"] = ov
 
     def frontier():
         # frontier-driven execution in the flagship configuration

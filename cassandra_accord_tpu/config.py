@@ -121,6 +121,39 @@ class LocalConfig:
     slow_peer_latency_threshold_s: float = 1.0
     slow_peer_penalty_s: float = 5.0
 
+    # -- overload robustness (local/overload.py) ------------------------------
+    # admission control: a node sheds NEW work (replica-side PreAccepts via a
+    # fast Overloaded nack; harness clients check before dispatching) while
+    # its composite load signal — outstanding RPC callbacks + unapplied
+    # execution pressure — sits above the high watermark, until it drains
+    # below the low watermark (hysteresis).  Default OFF: with the default
+    # config every trajectory is byte-identical to the pre-overload tree.
+    admission_enabled: bool = False
+    admission_hi: int = 48                  # shed at/above this composite load
+    admission_lo: int = 32                  # readmit at/below this (hysteresis)
+    admission_pressure_age_s: float = 5.0   # unapplied-pressure age horizon
+    # coordinator routing: after an Overloaded nack (or a piggybacked load
+    # bit) the peer counts as slow for this window — reads route around it
+    overload_penalty_s: float = 2.0
+    # replies piggyback the replica's current overload bit so coordinators
+    # learn of pressure without waiting for a shed (only consulted when
+    # admission is enabled)
+    backpressure_piggyback: bool = True
+    # retry budgets: deterministic token buckets (hash-jittered refill, zero
+    # RNG-stream consumption) gate the unbounded retry surfaces — progress-log
+    # investigation/blocked-fetch launches and the bootstrap re-fencing
+    # ladder.  A denied launch defers to the next poll/rung instead of
+    # joining a herd.  Default OFF.
+    # defaults sized to bind only on storms: a store's normal recovery drain
+    # runs tens of investigations per sim-second — a budget tighter than that
+    # throttles the HEAL rate and manufactures the very goodput collapse it
+    # exists to prevent (measured on the round-14 ramp oracle: rate 4/s
+    # stretched the post-overload drain tail 2-3x)
+    retry_budget_enabled: bool = False
+    retry_budget_rate_s: float = 32.0       # tokens per sim-second
+    retry_budget_burst: float = 64.0        # bucket capacity
+    retry_budget_jitter: float = 0.25       # refill-rate jitter fraction
+
     # -- columnar protocol engine (protocol_batch/) ---------------------------
     # struct-of-arrays txn batches over command-store hot state + vectorized
     # release/frontier/progress scans.  "off" keeps every legacy code path
@@ -168,6 +201,15 @@ class LocalConfig:
          lambda v: v.lower()),
         ("ACCORD_JOURNAL_TORN_TAIL_CHANCE", "journal_torn_tail_chance", float),
         ("ACCORD_JOURNAL_CORRUPT_CHANCE", "journal_corrupt_chance", float),
+        ("ACCORD_ADMISSION", "admission_enabled",
+         lambda v: v.lower() not in ("", "0", "off", "false")),
+        ("ACCORD_ADMISSION_HI", "admission_hi", int),
+        ("ACCORD_ADMISSION_LO", "admission_lo", int),
+        ("ACCORD_OVERLOAD_PENALTY", "overload_penalty_s", float),
+        ("ACCORD_RETRY_BUDGET", "retry_budget_enabled",
+         lambda v: v.lower() not in ("", "0", "off", "false")),
+        ("ACCORD_RETRY_BUDGET_RATE", "retry_budget_rate_s", float),
+        ("ACCORD_RETRY_BUDGET_BURST", "retry_budget_burst", float),
         ("ACCORD_REPLY_BACKOFF_MAX", "reply_backoff_max_s", float),
         ("ACCORD_REPLY_REARM_BUDGET", "reply_rearm_budget", int),
         ("ACCORD_COLUMNAR", "columnar", lambda v: v.lower()),

@@ -32,6 +32,12 @@ class Exhausted(CoordinationFailed):
     """Too many replicas failed to achieve a quorum."""
 
 
+class Overloaded(CoordinationFailed):
+    """Shed by admission control: the node refused new work while over its
+    load watermark.  A fast, explicit nack — the caller learns in one
+    round-trip what a timeout would have taken seconds to say."""
+
+
 class Insufficient(CoordinationFailed):
     """A replica lacked the state needed to process a request."""
 

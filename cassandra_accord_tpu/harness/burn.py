@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..coordinate.errors import CoordinationFailed, Invalidated
+from ..coordinate.errors import CoordinationFailed, Invalidated, Overloaded
 from ..impl.list_store import ListResult, list_txn, range_read_txn
 from ..local.status import SaveStatus, Status
 from ..primitives.keys import IntKey, Range, Ranges
@@ -45,6 +45,13 @@ class BurnResult:
         self.disk_stalls = 0     # journal-append stalls
         self.joins = 0           # elastic membership: nodes joined mid-burn
         self.leaves = 0          # elastic membership: decommissions mid-burn
+        # overload plane (PR-17): sheds count into ops_failed too (they ARE
+        # client-visible fast failures) — this is the attribution split
+        self.ops_shed = 0        # client-entry admission sheds (subset of failed)
+        self.overload_nacks = 0  # replica-side Overloaded nacks sent
+        self.budget_denied = 0   # retry-budget token denials
+        self.paced_arrivals = 0  # open-loop arrivals drawn while AIMD-paced
+        self.pace_downs = 0      # AIMD pace-down events
         self.sim_micros = 0
         self.stats: Dict[str, int] = {}
         self.audit: Optional[dict] = None   # InvariantAuditor verdict, if on
@@ -61,10 +68,11 @@ class BurnResult:
         stalls = f", disk_stalls={self.disk_stalls}" if self.disk_stalls else ""
         joins = f", joins={self.joins}" if self.joins else ""
         leaves = f", leaves={self.leaves}" if self.leaves else ""
+        shed = f", shed={self.ops_shed}" if self.ops_shed else ""
         return (f"BurnResult(seed={self.seed}, ok={self.ops_ok}, "
                 f"recovered={self.ops_recovered}, nacked={self.ops_nacked}, "
-                f"lost={self.ops_lost}, failed={self.ops_failed}{restarts}"
-                f"{pauses}{stalls}{joins}{leaves}, "
+                f"lost={self.ops_lost}, failed={self.ops_failed}{shed}"
+                f"{restarts}{pauses}{stalls}{joins}{leaves}, "
                 f"sim_ms={self.sim_micros // 1000})")
 
 
@@ -158,6 +166,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              history_recorder=None,
              workload=None,
              rate_txn_s: float = 25.0,
+             load_phases=None,
              control_timeout_s: float = 60.0,
              progress_every_s: Optional[float] = None,
              progress_label: str = "") -> BurnResult:
@@ -237,6 +246,12 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     ``concurrency`` window.  ``control_timeout_s``: barrier/sync-point ops
     (multirange) have no txn id the client could probe, so an unresolved
     control op resolves as lost after this much sim-time.
+
+    ``load_phases``: open-loop offered-load schedule — a list of
+    ``(start_sim_s, rate_mult)`` phases driven by the deterministic
+    LoadSpikeNemesis (the overload ramp/burst presets).  Requires an
+    open-loop workload.  Per-phase goodput lands in
+    ``result.stats["load_phase{i}_ok"]``.
     """
     from ..config import LocalConfig
     if audit not in ("off", "strict", "warn"):
@@ -263,9 +278,6 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     n_nodes = nodes if nodes is not None else rng.next_int(rf, 2 * rf)
     key_count = key_count if key_count is not None else rng.next_int(5, 21)
     node_ids = list(range(1, n_nodes + 1))
-    if progress_log is None:
-        # recovery must be live whenever coordinators can die mid-flight
-        progress_log = chaos or restart_nodes
     if restart_nodes:
         assert journal, "restart_nodes requires journal=True (the restart " \
                         "store of record)"
@@ -274,6 +286,13 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             "journal logs by store id, and multi-store range assignment " \
             "is not stable across a restart boundary"
     cfg = node_config if node_config is not None else LocalConfig.from_env()
+    if progress_log is None:
+        # recovery must be live whenever coordinators can die mid-flight —
+        # and whenever admission control can NACK a PreAccept: the nack is a
+        # partial failure (some replicas witnessed the txn), and only the
+        # progress log settles the orphan the rest of the deps graph
+        # blocks behind
+        progress_log = chaos or restart_nodes or cfg.admission_enabled
     if columnar is not None:
         # the columnar protocol engine knob (protocol_batch/): auto|on|off.
         # By the exact-skip contract the knob NEVER changes a trajectory —
@@ -462,6 +481,10 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     # whose coordinator died mid-flight (the reference burn's external client
     # resolving a dead coordinator's silence through CheckStatus probes)
     inflight: Dict[int, dict] = {}
+    # per-load-phase goodput buckets (overload burst recovery measurement);
+    # load_nemesis is bound below, after the other nemeses
+    load_nemesis = None
+    phase_ok: Dict[int, int] = {}
 
     def pick_coordinator():
         # liveness precheck WITHOUT touching the rng (keeps seeded streams
@@ -512,6 +535,22 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         else:
             obs.fail(now)
             result.ops_failed += 1
+        if workload_obj is not None and workload_obj.open_loop:
+            # client-side AIMD: a shed backs the offered rate off
+            # multiplicatively, a success recovers it gradually — the
+            # backpressure loop that keeps overload from going metastable
+            if rec.get("shed"):
+                workload_obj.on_shed()
+            elif kind in ("ok", "recovered"):
+                workload_obj.on_ok()
+            if kind in ("ok", "recovered") and state["submitted"] < ops:
+                # a commit landing while arrivals are still being offered:
+                # the honest goodput numerator (drain-tail commits after the
+                # last arrival are latency, not sustained throughput)
+                state["window_ok"] = state.get("window_ok", 0) + 1
+        if load_nemesis is not None and kind in ("ok", "recovered"):
+            ph = load_nemesis.phase_of(now / 1e6)
+            phase_ok[ph] = phase_ok.get(ph, 0) + 1
         submit_next()
 
     def probe(coordinator, rec: dict, attempt: int) -> None:
@@ -589,6 +628,27 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         invoke, coordinate + resolution callback (shared by the classic
         generator and every workload preset)."""
         coordinator = pick_coordinator()
+        adm = getattr(coordinator, "admission", None)
+        if adm is not None and adm.overloaded():
+            # client-entry shed: refused BEFORE a txn id exists, so the fast
+            # client-visible failure is sound — the txn provably never
+            # entered the system (the round-13 fresh-values rule lets the
+            # history checker treat a `fail` as definitely-not-applied)
+            adm.sheds += 1
+            result.ops_shed += 1
+            obs = verifier.begin(cluster.now_micros)
+            rec = {"op_id": op_id, "obs": obs, "txn_id": None, "route": None,
+                   "writes": {}, "coordinator": coordinator.id,
+                   "settled": False, "shed": True}
+            inflight[op_id] = rec
+            if history_rec is not None:
+                history_rec.invoke(op_id, None, cluster.now_micros,
+                                   read_keys, writes)
+            if observer is not None:
+                observer.registry.counter("overload.shed",
+                                          node=coordinator.id).inc()
+            resolve(rec, "failed")
+            return
         txn_id = coordinator.next_txn_id(txn.kind, txn.domain)
         route = txn.to_route()
         obs = verifier.begin(cluster.now_micros)
@@ -606,6 +666,12 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             on_submit(op_id, txn_id, txn, coordinator.id)
 
         def on_done(value, failure, rec=rec, coordinator=coordinator):
+            if isinstance(failure, Overloaded) and workload_obj is not None \
+                    and workload_obj.open_loop:
+                # a replica-side admission nack surfaced as the coordination
+                # outcome: pace the open-loop client down before resolving
+                # through the normal lost-response machinery
+                workload_obj.on_shed()
             if failure is None and isinstance(value, ListResult):
                 resolve(rec, "ok", reads=dict(value.reads),
                         writes=dict(rec["writes"]))
@@ -712,6 +778,7 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         def fire():
             if state["submitted"] >= ops:
                 return
+            state["last_arrival_us"] = cluster.now_micros
             submit_workload_op()
             arm()
 
@@ -780,6 +847,13 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             stall_min_s=cfg.disk_stall_min_s,
             stall_max_s=cfg.disk_stall_max_s)
         disk_nemesis.attach()
+    if load_phases:
+        assert workload_obj is not None and workload_obj.open_loop, \
+            "load_phases requires an open-loop workload (the offered-load " \
+            "multiplier scales arrival rates)"
+        from .nemesis import LoadSpikeNemesis
+        load_nemesis = LoadSpikeNemesis(cluster, workload_obj, load_phases)
+        load_nemesis.attach()
     watchdog = None
     if stall_watchdog_s is not None:
         from .watchdog import StallWatchdog
@@ -834,6 +908,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         if disk_nemesis is not None:
             # everything buffered becomes durable; held packets hit the wire
             disk_nemesis.stop_and_restore()
+        if load_nemesis is not None:
+            load_nemesis.stop()
         if nemesis is not None:
             # restore every down node BEFORE judging final state: the
             # agreement checks need the full replica set live and caught up
@@ -887,6 +963,32 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         result.disk_stalls = cluster.stats.get("journal_stalls", 0)
         result.joins = cluster.stats.get("node_joins", 0)
         result.leaves = cluster.stats.get("node_decommissions", 0)
+        # overload plane: admission nacks + retry-budget denials, summed from
+        # plain per-node counters (observer-free by design — the zero-
+        # observer-effect contract extends to the overload.* series); current
+        # incarnations only, like every other per-node end-of-run sum
+        for node in cluster.nodes.values():
+            oc = getattr(node, "overload_counters", None)
+            if oc:
+                result.overload_nacks += oc.get("nacks", 0)
+                result.budget_denied += oc.get("budget_denied", 0)
+        if workload_obj is not None and workload_obj.open_loop:
+            result.paced_arrivals = workload_obj.paced_arrivals
+            result.pace_downs = workload_obj.pace_downs
+        for key, val in (("overload_nacks", result.overload_nacks),
+                         ("overload_budget_denied", result.budget_denied),
+                         ("ops_shed", result.ops_shed),
+                         ("paced_arrivals", result.paced_arrivals)):
+            if val:
+                result.stats[key] = val
+        for ph, n_ok in sorted(phase_ok.items()):
+            result.stats[f"load_phase{ph}_ok"] = n_ok
+        if state.get("last_arrival_us"):
+            # the offered-load window (first to last open-loop arrival): the
+            # overload oracles measure goodput against THIS, not total sim
+            # time — the post-arrival drain tail is latency, not throughput
+            result.stats["last_arrival_us"] = state["last_arrival_us"]
+            result.stats["window_ok_commits"] = state.get("window_ok", 0)
         # per-key execution-register inversion diagnostic (TimestampsForKey):
         # surfaced in every burn's stats; MUST be 0 in benign runs (asserted
         # by test_timestamps_for_key) — growth under chaos pages the Agent
@@ -938,11 +1040,13 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                 f"{result!r}")
         if not allow_failures and result.ops_failed:
             raise HistoryViolation(f"{result.ops_failed} ops failed unexpectedly")
-        if not chaos and not restart_nodes \
+        if not chaos and not restart_nodes and not cfg.admission_enabled \
                 and (result.ops_lost or result.ops_recovered
                      or (not allow_failures and result.ops_nacked)):
             # (a crashed coordinator legitimately turns acks into
-            # probe-recovered / lost resolutions even on a benign network)
+            # probe-recovered / lost resolutions even on a benign network —
+            # and so does an admission nack: the shed PreAccept is a partial
+            # failure the client resolves through probes)
             raise HistoryViolation(
                 f"benign network must ack everything: {result!r}")
         # final replica state must agree per key across replicas covering it
@@ -1032,6 +1136,167 @@ def reconcile(seed: int, **kwargs):
     return a, b
 
 
+def build_slo_specs(latency_s=None, budget=None, windows=None):
+    """CLI SloSpec tuning (``--slo-latency/--slo-budget/--slo-windows``).
+
+    ``SloSpec`` is an immutable ``__slots__`` class, so overrides rebuild the
+    DEFAULT_SLOS tuple with fresh instances.  Returns None when nothing is
+    overridden (callers keep the shared defaults).  ``windows`` is
+    ``"short:long"`` in sim-seconds; ``latency_s`` applies to latency-kind
+    specs only (liveness has no latency threshold)."""
+    if latency_s is None and budget is None and windows is None:
+        return None
+    from ..observe.burnrate import DEFAULT_SLOS, SloSpec
+    short_s = long_s = None
+    if windows is not None:
+        s, sep, l = str(windows).partition(":")
+        if not sep:
+            raise ValueError(f"--slo-windows wants SHORT:LONG sim-seconds, "
+                             f"got {windows!r}")
+        short_s, long_s = float(s), float(l)
+    specs = []
+    for spec in DEFAULT_SLOS:
+        specs.append(SloSpec(
+            spec.name, spec.kind,
+            budget=float(budget) if budget is not None else spec.budget,
+            short_s=short_s if short_s is not None else spec.short_us / 1e6,
+            long_s=long_s if long_s is not None else spec.long_us / 1e6,
+            burn_threshold=spec.burn_threshold,
+            min_bad=spec.min_bad,
+            latency_slo_us=int(float(latency_s) * 1e6)
+            if latency_s is not None and spec.kind == "latency"
+            else spec.latency_slo_us))
+    return tuple(specs)
+
+
+def _overload_observer(slo_specs):
+    """Fresh warn-mode auditor + burn-rate monitor pair for one overload
+    point (each burn needs its own: the monitors are stateful)."""
+    from ..observe import BurnRateMonitor, InvariantAuditor
+    monitor = BurnRateMonitor(specs=slo_specs) if slo_specs \
+        else BurnRateMonitor()
+    return InvariantAuditor(mode="warn", burnrate=monitor), monitor
+
+
+def _goodput(result) -> float:
+    """Committed client ops per sim-second of OFFERED-LOAD time: commits
+    that landed while arrivals were still being offered, over the
+    first-to-last-arrival window.  Drain-tail commits (after the last
+    arrival) are excluded from BOTH numerator and denominator — they are
+    latency, not sustained throughput; the latency SLO monitors are the
+    oracle for "committed but far too slow"."""
+    window_us = result.stats.get("last_arrival_us", result.sim_micros)
+    ok = result.stats.get("window_ok_commits",
+                          result.ops_ok + result.ops_recovered)
+    return ok / max(window_us / 1e6, 1e-9)
+
+
+def run_overload_ramp(seed: int, kw: dict, rate_txn_s: float,
+                      mults=(0.5, 1.0, 2.0, 4.0), frac: float = 0.8,
+                      slo_specs=None) -> dict:
+    """The metastability ramp oracle: sequential open-loop burns at each
+    offered-load multiple of the estimated capacity rate.  Pass iff goodput
+    at every overload point (mult > 1) holds >= ``frac`` of the 1x
+    capacity-goodput — a metastable collapse shows up as goodput CRATERING
+    past saturation instead of plateauing (shed ops are fast client-visible
+    failures, not goodput).  ``kw`` carries the fault matrix + an
+    admission/budget-enabled node_config; each point gets a fresh warn-mode
+    auditor so SLO flags ride the verdict.
+
+    The ramp clients are deliberately UNCOOPERATIVE (AIMD pacing off): a
+    metastability probe must hold the offered rate no matter what the
+    cluster signals, so the floor it measures is the server-side defense
+    alone (admission + budgets).  The burst oracle is the cooperative-client
+    counterpart — there AIMD pacing is exactly what is being demonstrated."""
+    from .workload import OpenLoopWorkload
+    out = {"mode": "ramp", "rate_txn_s": rate_txn_s,
+           "mults": [float(m) for m in mults], "frac": frac, "points": []}
+    baseline = None
+    base_ops = int(kw.get("ops") or 200)
+    for mult in mults:
+        kw2 = dict(kw)
+        observer, monitor = _overload_observer(slo_specs)
+        kw2["observer"] = observer
+        kw2["workload"] = OpenLoopWorkload(
+            rate_txn_s=rate_txn_s * float(mult), aimd=False)
+        # hold the ARRIVAL WINDOW constant across points (ops scales with
+        # the rate) so every goodput measurement spans the same sim-seconds
+        kw2["ops"] = max(int(base_ops * float(mult)), 20)
+        r = run_burn(seed, rate_txn_s=rate_txn_s * float(mult), **kw2)
+        point = {"mult": float(mult),
+                 "goodput_txn_s": round(_goodput(r), 3),
+                 "ok": r.ops_ok, "recovered": r.ops_recovered,
+                 "failed": r.ops_failed, "shed": r.ops_shed,
+                 "nacks": r.overload_nacks,
+                 "budget_denied": r.budget_denied,
+                 "paced": r.paced_arrivals,
+                 "sim_s": round(r.sim_micros / 1e6, 2),
+                 "violations": (r.audit or {}).get("violations", 0),
+                 "slo_burn_events": monitor.report()["slo_burn_events"]}
+        out["points"].append(point)
+        if float(mult) == 1.0:
+            baseline = point["goodput_txn_s"]
+    over = [p for p in out["points"] if p["mult"] > 1.0]
+    clean = all(p["violations"] == 0 for p in out["points"])
+    if baseline and over:
+        worst = min(p["goodput_txn_s"] for p in over)
+        out["capacity_goodput_txn_s"] = baseline
+        out["goodput_floor_frac"] = round(worst / baseline, 3)
+        out["passed"] = bool(worst >= frac * baseline and clean)
+    else:
+        out["passed"] = clean   # no goodput comparison — audit alone
+    return out
+
+
+def run_overload_burst(seed: int, kw: dict, rate_txn_s: float,
+                       burst_mult: float = 4.0, pre_s: float = 30.0,
+                       burst_s: float = 20.0, post_s: float = 40.0,
+                       frac: float = 0.8, slo_specs=None) -> dict:
+    """The burst-then-recover oracle: one open-loop burn whose offered load
+    steps 1x -> ``burst_mult`` -> 1x on the deterministic LoadSpikeNemesis
+    schedule.  Pass iff post-burst goodput recovers to >= ``frac`` of
+    pre-burst goodput within the bounded post window AND the run ends with
+    zero open SLO flags/burns — the signature of a metastable failure is
+    exactly a system that does NOT recover when the trigger is removed."""
+    phases = [(0.0, 1.0), (pre_s, float(burst_mult)),
+              (pre_s + burst_s, 1.0)]
+    # size the op count to span the whole schedule (arrivals stop at `ops`)
+    ops = max(int(rate_txn_s * (pre_s + burst_s * float(burst_mult)
+                                + post_s)), 50)
+    kw2 = dict(kw, ops=ops, load_phases=phases)
+    kw2.setdefault("workload", "openloop")
+    observer, monitor = _overload_observer(slo_specs)
+    kw2["observer"] = observer
+    r = run_burn(seed, rate_txn_s=rate_txn_s, **kw2)
+    sim_s = r.sim_micros / 1e6
+    pre_ok = r.stats.get("load_phase0_ok", 0)
+    burst_ok = r.stats.get("load_phase1_ok", 0)
+    post_ok = r.stats.get("load_phase2_ok", 0)
+    post_dur = max(sim_s - (pre_s + burst_s), 1e-9)
+    pre_goodput = pre_ok / pre_s
+    post_goodput = post_ok / post_dur
+    rep = monitor.report()
+    open_flags = (r.audit or {}).get("slo_flags_open", 0)
+    recovered = pre_goodput == 0.0 or post_goodput >= frac * pre_goodput
+    out = {"mode": "burst", "rate_txn_s": rate_txn_s,
+           "burst_mult": float(burst_mult), "frac": frac, "ops": ops,
+           "pre_goodput_txn_s": round(pre_goodput, 3),
+           "burst_goodput_txn_s": round(burst_ok / burst_s, 3),
+           "post_goodput_txn_s": round(post_goodput, 3),
+           "recovery_sim_s": round(post_dur, 2),
+           "shed": r.ops_shed, "nacks": r.overload_nacks,
+           "budget_denied": r.budget_denied, "paced": r.paced_arrivals,
+           "sim_s": round(sim_s, 2),
+           "slo_burn_events": rep["slo_burn_events"],
+           "open_slo_burns": len(rep["open_slo_burns"]),
+           "slo_flags_open": open_flags,
+           "violations": (r.audit or {}).get("violations", 0),
+           "passed": bool(recovered and not rep["open_slo_burns"]
+                          and open_flags == 0
+                          and (r.audit or {}).get("violations", 0) == 0)}
+    return out
+
+
 def _append_trend(record: dict) -> None:
     """Ledger a record into BENCH_HISTORY.jsonl via tools/trend.py.
     Best-effort: the ledger must never be able to fail a burn."""
@@ -1061,7 +1326,9 @@ def _sweep_worker(seed: int, kw: dict) -> dict:
         entry.update(status="pass", resolved=result.resolved,
                      ok=result.ops_ok, recovered=result.ops_recovered,
                      nacked=result.ops_nacked, lost=result.ops_lost,
-                     failed=result.ops_failed,
+                     failed=result.ops_failed, shed=result.ops_shed,
+                     paced=result.paced_arrivals,
+                     budget_denied=result.budget_denied,
                      sim_ms=result.sim_micros // 1000)
         if result.history is not None:
             entry["history"] = {k: result.history[k]
@@ -1173,6 +1440,32 @@ def main(argv=None) -> None:
     p.add_argument("--rate", type=float, default=25.0, metavar="TXN_S",
                    help="openloop arrival rate, txn per sim-second "
                         "(default 25)")
+    p.add_argument("--overload", default=None, choices=["ramp", "burst"],
+                   help="overload-robustness oracle (implies --workload "
+                        "openloop, admission control + retry budgets ON): "
+                        "ramp = sequential burns at --overload-mults x "
+                        "--rate, pass iff goodput past saturation holds "
+                        ">= --overload-frac of the 1x capacity-goodput; "
+                        "burst = one burn whose offered load steps "
+                        "1x -> 4x -> 1x, pass iff post-burst goodput "
+                        "recovers and zero SLO flags stay open.  Exit code "
+                        "4 on acceptance failure (2 stays the stall exit)")
+    p.add_argument("--overload-mults", default="0.5,1,2,4", metavar="M,M,..",
+                   help="ramp offered-load multipliers (default 0.5,1,2,4)")
+    p.add_argument("--overload-frac", type=float, default=0.8,
+                   metavar="FRAC",
+                   help="acceptance floor: overload goodput >= FRAC x "
+                        "capacity-goodput (default 0.8)")
+    p.add_argument("--slo-latency", type=float, default=None, metavar="SIM_S",
+                   help="commit-latency SLO threshold in sim-seconds "
+                        "(default 5.0) for the burn-rate monitors")
+    p.add_argument("--slo-budget", type=float, default=None, metavar="FRAC",
+                   help="SLO error-budget fraction in (0,1) applied to "
+                        "every monitor (defaults: latency 0.05, "
+                        "liveness 0.02)")
+    p.add_argument("--slo-windows", default=None, metavar="SHORT:LONG",
+                   help="burn-rate window pair in sim-seconds "
+                        "(default 5:30)")
     p.add_argument("--parallel-seeds", type=int, default=0, metavar="N",
                    help="run the seed range across N worker processes "
                         "(spawn pool; observers/artifacts stay off in "
@@ -1250,6 +1543,9 @@ def main(argv=None) -> None:
     if not args.no_watchdog:
         watchdog_s = args.watchdog_stall if args.watchdog_stall is not None \
             else cfg.stall_watchdog_after_s
+    # --slo-* overrides rebuild the DEFAULT_SLOS tuple (None = defaults)
+    slo_specs = build_slo_specs(args.slo_latency, args.slo_budget,
+                                args.slo_windows)
     if args.matrix == "big":
         import os as _os
         if "ACCORD_LONG_BURNS" not in _os.environ:
@@ -1338,6 +1634,91 @@ def main(argv=None) -> None:
                   max_tasks=200_000_000)
         return rf, kw
 
+    if args.overload:
+        if args.workload not in (None, "openloop"):
+            raise SystemExit("--overload drives the openloop workload "
+                             f"(got --workload {args.workload})")
+        if args.reconcile or args.parallel_seeds > 1:
+            raise SystemExit("--overload does not compose with --reconcile/"
+                             "--parallel-seeds (the oracle is itself a "
+                             "multi-burn schedule)")
+        # the defense under test: admission control + retry budgets ON
+        ov_cfg = _replace(cfg, admission_enabled=True,
+                          retry_budget_enabled=True)
+        try:
+            mults = tuple(float(m) for m in args.overload_mults.split(",")
+                          if m.strip())
+        except ValueError:
+            raise SystemExit(f"--overload-mults wants comma-separated "
+                             f"floats, got {args.overload_mults!r}")
+        failures = 0
+        for seed in seeds:
+            _rf, kw = base_kw(seed)
+            kw.update(workload="openloop", node_config=ov_cfg,
+                      allow_failures=True)
+            kw.pop("rate_txn_s", None)   # the oracle sets the rate per point
+            if args.audit != "off":
+                kw["audit"] = args.audit
+                kw["audit_slo_s"] = args.audit_slo
+            t0 = _time.perf_counter()
+            entry = {"seed": seed, "overload": args.overload,
+                     "rate_txn_s": args.rate}
+            summaries.append(entry)
+            try:
+                if args.overload == "ramp":
+                    out = run_overload_ramp(
+                        seed, kw, args.rate, mults=mults,
+                        frac=args.overload_frac, slo_specs=slo_specs)
+                else:
+                    out = run_overload_burst(
+                        seed, kw, args.rate, frac=args.overload_frac,
+                        slo_specs=slo_specs)
+            except SimulationException as e:
+                entry.update(status="fail", error=str(e.cause)[:2000],
+                             wall_s=round(_time.perf_counter() - t0, 3))
+                write_json()
+                if isinstance(e.cause, StallError):
+                    print(f"seed {seed}: STALL during --overload "
+                          f"{args.overload}\n{e.cause.dump}")
+                    raise SystemExit(2)
+                raise
+            entry.update(status="pass" if out["passed"] else
+                         "overload_failed",
+                         wall_s=round(_time.perf_counter() - t0, 3),
+                         result=out)
+            if args.overload == "ramp":
+                metric, value = ("goodput_floor_frac",
+                                 out.get("goodput_floor_frac"))
+            else:
+                metric, value = ("recovery_sim_s", out.get("recovery_sim_s"))
+            _append_trend({"kind": "overload", "metric": metric,
+                           "value": value, "unit": "frac"
+                           if args.overload == "ramp" else "s",
+                           "mode": args.overload, "seeds": [seed],
+                           "rate_txn_s": args.rate,
+                           "capacity_goodput_txn_s":
+                           out.get("capacity_goodput_txn_s",
+                                   out.get("pre_goodput_txn_s")),
+                           "shed": out.get("shed", sum(
+                               p["shed"] for p in out.get("points", []))),
+                           "budget_denied": out.get("budget_denied", sum(
+                               p["budget_denied"]
+                               for p in out.get("points", []))),
+                           "paced": out.get("paced", sum(
+                               p["paced"] for p in out.get("points", []))),
+                           "passed": out["passed"]})
+            print(f"seed {seed}: overload {args.overload} "
+                  f"{'PASS' if out['passed'] else 'FAIL'} "
+                  f"({_time.perf_counter() - t0:.1f}s) {out}", flush=True)
+            if not out["passed"]:
+                failures += 1
+        write_json()
+        if failures:
+            # distinct exit code: the cluster survived (no stall, no
+            # violation) but FAILED the overload acceptance bar
+            raise SystemExit(4)
+        return
+
     if args.parallel_seeds > 1:
         if args.reconcile:
             raise SystemExit("--parallel-seeds does not compose with "
@@ -1397,7 +1778,8 @@ def main(argv=None) -> None:
         monitor = None
         if args.burnrate and not args.reconcile:
             from ..observe import BurnRateMonitor
-            monitor = BurnRateMonitor()
+            monitor = BurnRateMonitor(specs=slo_specs) if slo_specs \
+                else BurnRateMonitor()
         if args.audit != "off" and not args.reconcile:
             # the auditor IS a FlightRecorder, so it also serves
             # --metrics-out/--trace-out (reconcile runs construct their own
@@ -1490,6 +1872,9 @@ def main(argv=None) -> None:
                     resolved=result.resolved, ok=result.ops_ok,
                     recovered=result.ops_recovered, nacked=result.ops_nacked,
                     lost=result.ops_lost, failed=result.ops_failed,
+                    shed=getattr(result, "ops_shed", 0),
+                    paced=getattr(result, "paced_arrivals", 0),
+                    budget_denied=getattr(result, "budget_denied", 0),
                     sim_ms=result.sim_micros // 1000,
                     faults={k: result.stats[k] for k in _FAULT_KEYS
                             if result.stats.get(k)})

@@ -21,7 +21,7 @@ from ..primitives.timestamp import Timestamp
 from ..topology.topology import Shard, Topology
 from ..utils import async_ as au
 from ..utils.random import RandomSource
-from ..coordinate.errors import Timeout
+from ..coordinate.errors import Overloaded, Timeout
 
 
 class PendingQueue:
@@ -145,6 +145,15 @@ class SlowReplicaTracker:
 
     def record_timeout(self, peer: int) -> None:
         self.slow_until[peer] = self.cluster.queue.now_micros + self.penalty_us
+
+    def record_overloaded(self, peer: int) -> None:
+        """An Overloaded nack (or a piggybacked load bit) from ``peer``: treat
+        it like a slow peer for the overload penalty window, so coordinators
+        route reads around it instead of feeding the hot node more work.
+        Never shortens an existing penalty (a timeout's window stands)."""
+        until = self.cluster.queue.now_micros + self.cluster.overload_penalty_us
+        if until > self.slow_until.get(peer, -1):
+            self.slow_until[peer] = until
 
     def is_slow(self, peer: int) -> bool:
         if self.ewma.get(peer, 0.0) > self.threshold_us:
@@ -382,9 +391,20 @@ class SimMessageSink(MessageSink):
         if not self.is_live():
             return   # dead incarnation: replies die with the process
         cluster = self.cluster
+        # backpressure piggyback: stamp the reply's wire journey with this
+        # replica's CURRENT overload bit (send-time state — deterministic),
+        # so coordinators learn of pressure from every reply, not only from
+        # the sheds.  Reply objects stay untouched (no schema change); the
+        # bit rides the routing call.
+        hot = False
+        if cluster.backpressure_piggyback:
+            node = cluster.nodes.get(self.node_id)
+            adm = getattr(node, "admission", None)
+            hot = adm is not None and adm.overloaded()
 
         def emit():
-            cluster.route_reply(self.node_id, to, reply_context, reply)
+            cluster.route_reply(self.node_id, to, reply_context, reply,
+                                overloaded=hot)
         if to != self.node_id and cluster.journal is not None \
                 and cluster.journal.is_stalled(self.node_id):
             cluster.hold_send(self.node_id, emit)
@@ -392,7 +412,8 @@ class SimMessageSink(MessageSink):
             emit()
 
     # -- inbound correlation -------------------------------------------------
-    def deliver_reply(self, from_node: int, msg_id: int, reply: Reply) -> None:
+    def deliver_reply(self, from_node: int, msg_id: int, reply: Reply,
+                      overloaded: bool = False) -> None:
         entry = self.callbacks.get(msg_id)
         if entry is None:
             return
@@ -402,6 +423,11 @@ class SimMessageSink(MessageSink):
         # original send would fold a txn's whole dependency wait into the
         # peer's "latency" and mark healthy-but-working replicas slow
         self.slow_replicas.record_reply(from_node, now - sent_at)
+        if overloaded or (isinstance(reply, FailureReply)
+                          and isinstance(reply.failure, Overloaded)):
+            # an explicit admission nack, or the piggybacked load bit:
+            # route around this peer like a slow one for the penalty window
+            self.slow_replicas.record_overloaded(from_node)
         if reply.is_final:
             timeout_entry.cancel()
             del self.callbacks[msg_id]
@@ -692,6 +718,13 @@ class Cluster:
                                  _cfg.slow_peer_latency_threshold_s,
                                  _cfg.slow_peer_penalty_s)
         self.journal_corruption_policy = _cfg.journal_corruption_policy
+        # overload plane (local/overload.py): how long an Overloaded nack (or
+        # a piggybacked load bit) marks the peer slow, and whether replies
+        # carry the bit at all — piggyback only matters when admission is on
+        # (off by default: the reply path stays bit-for-bit untouched)
+        self.overload_penalty_us = int(_cfg.overload_penalty_s * 1_000_000)
+        self.backpressure_piggyback = (_cfg.backpressure_piggyback
+                                       and _cfg.admission_enabled)
         # catch-up ranges a restart has accepted but not yet handed to
         # Bootstrap (the +1us relaunch task): a second crash inside that
         # window must re-inherit them, not forget the data holes
@@ -1236,7 +1269,7 @@ class Cluster:
         node.receive(request, from_node, ctx)
 
     def route_reply(self, from_node: int, to_node: int, reply_context: ReplyContext,
-                    reply: Reply) -> None:
+                    reply: Reply, overloaded: bool = False) -> None:
         self._count(f"{type(reply).__name__}")
         action = self.link.action(from_node, to_node, reply) if from_node != to_node \
             else LinkConfig.DELIVER
@@ -1257,7 +1290,7 @@ class Cluster:
             self._trace("RECV_RPLY", from_node, to_node,
                         reply_context.msg_id, reply)
             self.sinks[to_node].deliver_reply(from_node, reply_context.msg_id,
-                                              reply)
+                                              reply, overloaded=overloaded)
         self.queue.add_after(latency, deliver)
 
     def _count(self, key: str) -> None:

@@ -348,6 +348,64 @@ class PauseNemesis:
             self.cluster.resume(node_id)
 
 
+class LoadSpikeNemesis:
+    """Deterministic offered-load schedule for open-loop burns: a list of
+    ``(start_s, rate_mult)`` phases, each armed as ONE absolute sim-time
+    timer that sets the workload's ``rate_mult``.  Unlike the gray-failure
+    nemeses this one is fully deterministic — no RNG, no jitter — because
+    the overload oracle compares goodput ACROSS multipliers, and a jittered
+    phase boundary would smear the measurement windows.
+
+    ``phase_of(now_s)`` reports which phase a given sim-instant falls in, so
+    the burn can bucket per-op outcomes by phase (the burst-recovery check
+    needs pre/burst/post goodput separately)."""
+
+    def __init__(self, cluster: Cluster, workload, phases):
+        # phases: iterable of (start_s, rate_mult), start_s ascending
+        self.cluster = cluster
+        self.workload = workload
+        self.phases = sorted((float(s), float(m)) for s, m in phases)
+        assert all(m > 0.0 for _, m in self.phases), \
+            "rate multipliers must be positive"
+        self.transitions = 0
+        self.stopped = False
+        self._tasks = []
+
+    def attach(self) -> None:
+        now_s = self.cluster.queue.now_micros / 1e6
+        for start_s, mult in self.phases:
+            delay = start_s - now_s
+            if delay <= 0.0:
+                self._enter(mult)
+                continue
+            self._tasks.append(self.cluster.scheduler.once(
+                delay, lambda m=mult: self._enter(m)))
+
+    def _enter(self, mult: float) -> None:
+        if self.stopped:
+            return
+        self.workload.rate_mult = mult
+        self.transitions += 1
+        self.cluster.stats["load_phase_transitions"] = \
+            self.cluster.stats.get("load_phase_transitions", 0) + 1
+
+    def phase_of(self, now_s: float) -> int:
+        """Index of the phase containing ``now_s`` (-1 before the first)."""
+        idx = -1
+        for i, (start_s, _mult) in enumerate(self.phases):
+            if now_s >= start_s:
+                idx = i
+        return idx
+
+    def stop(self) -> None:
+        """Freeze the schedule (burn quiesce): pending phase timers no-op."""
+        self.stopped = True
+        for task in self._tasks:
+            cancel = getattr(task, "cancel", None)
+            if cancel is not None:
+                cancel()
+
+
 class DiskStallNemesis:
     """Journal-append stalls at seeded, jittered points
     (``Cluster.stall_journal``): the victim keeps executing but nothing it

@@ -181,21 +181,53 @@ class ZipfWorkload(Workload):
 
 
 class OpenLoopWorkload(Workload):
-    """Poisson arrivals at ``rate_txn_s`` of sim-time, uniform key mix."""
+    """Poisson arrivals at ``rate_txn_s`` of sim-time, uniform key mix.
+
+    Overload hooks (PR-17): ``rate_mult`` is the nemesis-driven offered-load
+    multiplier (a ramp/burst phase setting 4.0 quadruples the arrival rate),
+    and ``pace`` is the client-side AIMD backpressure state — ``on_shed()``
+    multiplicatively stretches inter-arrival gaps when the cluster sheds,
+    ``on_ok()`` additively-ish recovers toward pace 1.0 on success.  Both
+    default to exactly 1.0, and ``x * 1.0`` is bitwise ``x`` in IEEE floats,
+    so the un-overloaded arrival stream is byte-identical to pre-PR-17."""
 
     name = "openloop"
     open_loop = True
 
-    def __init__(self, rate_txn_s: float = 25.0):
+    def __init__(self, rate_txn_s: float = 25.0, aimd: bool = True,
+                 aimd_backoff: float = 2.0, aimd_recover: float = 0.9,
+                 pace_max: float = 8.0):
         super().__init__()
         assert rate_txn_s > 0, "openloop needs a positive --rate"
         self.rate_txn_s = float(rate_txn_s)
+        self.rate_mult = 1.0         # nemesis-set offered-load multiplier
+        self.pace = 1.0              # AIMD gap stretch (1.0 = full rate)
+        self.aimd = aimd
+        self.aimd_backoff = float(aimd_backoff)
+        self.aimd_recover = float(aimd_recover)
+        self.pace_max = float(pace_max)
+        self.paced_arrivals = 0      # arrivals drawn while pace > 1.0
+        self.pace_downs = 0          # on_shed() events that stretched pace
+
+    def on_shed(self) -> None:
+        """A shed/Overloaded nack: multiplicatively back the offered rate
+        off (stretch the inter-arrival gap), capped at ``pace_max``."""
+        if self.aimd:
+            self.pace = min(self.pace_max, self.pace * self.aimd_backoff)
+            self.pace_downs += 1
+
+    def on_ok(self) -> None:
+        """A success: recover pace geometrically toward 1.0."""
+        if self.aimd and self.pace > 1.0:
+            self.pace = max(1.0, self.pace * self.aimd_recover)
 
     def next_arrival_s(self) -> float:
         # inverse-CDF exponential inter-arrival; 1-u keeps the argument in
         # (0, 1] (next_float may return exactly 0.0)
         u = 1.0 - self.rng.next_float()
-        return -math.log(u) / self.rate_txn_s
+        if self.pace > 1.0:
+            self.paced_arrivals += 1
+        return -math.log(u) * self.pace / (self.rate_txn_s * self.rate_mult)
 
     def next_op(self, op_id: int) -> WorkloadOp:
         rng = self.rng

@@ -109,6 +109,20 @@ class SimpleProgressLog(ProgressLog):
         rng = self.node.random.fork()
         interval = lambda: poll_interval_s * (0.6 + rng.next_float())  # noqa: E731
         self._scheduled = self.node.scheduler.recurring(interval, self._poll)
+        # retry budget (local/overload.py): a deterministic token bucket
+        # bounding investigation/blocked-fetch launches per sim-second.  The
+        # stagger window spreads a herd WITHIN a poll tick; the budget bounds
+        # the rate ACROSS ticks — under sustained overload the backlog would
+        # otherwise relaunch wholesale every poll.  None when the knob is off.
+        self._budget = None
+        cfg = getattr(self.node, "config", None)
+        if cfg is not None and cfg.retry_budget_enabled:
+            from ..local.overload import TokenBucket
+            self._budget = TokenBucket(
+                cfg.retry_budget_rate_s, cfg.retry_budget_burst,
+                cfg.retry_budget_jitter,
+                salt=(self.node.id << 16) ^ (store.id + 1),
+                now_s=self.node.now_micros() / 1e6)
 
     def close(self) -> None:
         self._scheduled.cancel()
@@ -278,6 +292,9 @@ class SimpleProgressLog(ProgressLog):
                 continue
             if state.in_cooldown():
                 continue
+            if not self._budget_ok():
+                state.cooldown = max(state.cooldown, 1)
+                continue
             state.progress = Progress.INVESTIGATING
             self._launch_staggered(lambda state=state: self._investigate(state))
 
@@ -308,6 +325,9 @@ class SimpleProgressLog(ProgressLog):
                 continue
             if state.in_cooldown():
                 continue
+            if not self._budget_ok():
+                state.cooldown = max(state.cooldown, 1)
+                continue
             state.progress = Progress.INVESTIGATING
             self._launch_staggered(
                 lambda state=state: self._resolve_blocked(state))
@@ -335,6 +355,24 @@ class SimpleProgressLog(ProgressLog):
         obs = getattr(self.node, "observer", None)
         if obs is not None:
             obs.on_progress(kind, self.node.id, self.store.id)
+
+    def _budget_ok(self) -> bool:
+        """Retry-budget gate for a monitor launch.  A denial defers the txn to
+        the next poll cycle (its monitor state is untouched beyond a one-poll
+        cooldown) — the backlog drains at the budgeted rate instead of
+        relaunching wholesale every tick."""
+        if self._budget is None:
+            return True
+        if self._budget.try_acquire(self.node.now_micros() / 1e6):
+            return True
+        counters = getattr(self.node, "overload_counters", None)
+        if counters is not None:
+            counters["budget_denied"] += 1
+        obs = getattr(self.node, "observer", None)
+        if obs is not None:
+            obs.registry.counter("overload.budget_denied", node=self.node.id,
+                                 store=self.store.id).inc()
+        return False
 
     def _investigate(self, state: _CoordinateState) -> None:
         from ..coordinate.maybe_recover import maybe_recover

@@ -58,6 +58,21 @@ class Bootstrap:
         self.catch_up = catch_up
         self.result = au.settable()
         self.attempts = 0
+        # retry budget (local/overload.py): every rung of the ladder allocates
+        # a fresh fence ESP that peers must then recover or invalidate — a
+        # whole-cluster refencing storm (KNOWN_ISSUES round 7) is many such
+        # ladders outrunning the heal rate.  The bucket bounds this store's
+        # rung rate; a denied rung stretches to the refence cap instead of
+        # firing.  None when the knob is off (default).
+        self._budget = None
+        cfg = getattr(node, "config", None)
+        if cfg is not None and cfg.retry_budget_enabled:
+            from .overload import TokenBucket
+            self._budget = TokenBucket(
+                cfg.retry_budget_rate_s, cfg.retry_budget_burst,
+                cfg.retry_budget_jitter,
+                salt=(node.id << 16) ^ (store.id + 0x5BD1) ^ epoch,
+                now_s=node.now_micros() / 1e6)
 
     def _retry_delay(self) -> float:
         """Exponential backoff for the attempt ladder (Bootstrap.Attempt).
@@ -78,7 +93,22 @@ class Bootstrap:
         # unapplied pressure (txns decided long ago, not applied — the
         # slo.unapplied condition), the ladder is outrunning partial-read
         # coverage assembly: stretch the rung so the reads win the race.
-        return refence_backoff(self.node, self.store, delay)
+        delay = refence_backoff(self.node, self.store, delay)
+        if self._budget is not None and not self._budget.try_acquire(
+                self.node.now_micros() / 1e6):
+            # budget denied: this rung would join a refencing herd — stretch
+            # it to the cap so the bucket refills before the next attempt
+            cfg = self.node.config
+            delay = max(delay, cfg.refence_backoff_max_s)
+            counters = getattr(self.node, "overload_counters", None)
+            if counters is not None:
+                counters["budget_denied"] += 1
+            obs = getattr(self.node, "observer", None)
+            if obs is not None:
+                obs.registry.counter("overload.budget_denied",
+                                     node=self.node.id,
+                                     store=self.store.id).inc()
+        return delay
 
     def start(self) -> au.AsyncResult:
         self.store.pending_bootstrap = self.store.pending_bootstrap.union(self.ranges)

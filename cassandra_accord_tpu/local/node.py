@@ -64,6 +64,17 @@ class Node(ConfigurationService.Listener):
         # determinism contract (it reads wall clocks) but still forbidden
         # from perturbing the sim (no RNG, no scheduling, no message path)
         self.profiler = None
+        # overload plane (local/overload.py): the admission controller exists
+        # only when its knob is on — the default-off path allocates nothing
+        # and every trajectory stays byte-identical.  The counters dict is
+        # plain state the retry budgets increment (budget denials) so the
+        # burn harness can sum them without an observer attached.
+        self.admission = None
+        if self.config.admission_enabled:
+            from .overload import AdmissionController
+            self.admission = AdmissionController(self)
+        self.overload_counters: Dict[str, int] = {"nacks": 0,
+                                                  "budget_denied": 0}
         self.topology = TopologyManager(node_id)
         self._epoch_watchdogs: set = set()
         self.command_stores = CommandStores(self, num_shards, executor_factory)
@@ -258,14 +269,50 @@ class Node(ConfigurationService.Listener):
     def add_exclusive_sync_point_listener(self, listener) -> None:
         self._exclusive_sync_point_listeners.append(listener)
 
+    def overloaded(self) -> bool:
+        """Admission verdict for NEW work (False when admission is off).
+        Harness clients consult this before dispatching a coordination —
+        a shed there is provably sound (no txn id was ever allocated)."""
+        return self.admission is not None and self.admission.overloaded()
+
     # -- message dispatch (Node.java:705, :425-527) ---------------------------
     def receive(self, request: "Request", from_node: int, reply_context) -> None:
+        if self.admission is not None and self._admission_nack(
+                request, from_node, reply_context):
+            return
         wait_for = request.wait_for_epoch()
         if wait_for > 0 and not self.topology.has_epoch(wait_for):
             self.with_epoch(wait_for).begin(
                 lambda _v, f: self._process_or_fail(request, from_node, reply_context, f))
             return
         self._process_or_fail(request, from_node, reply_context, None)
+
+    def _admission_nack(self, request: "Request", from_node: int,
+                        reply_context) -> bool:
+        """Shed work-INITIATING requests with a fast explicit Overloaded nack
+        while over the watermark.  Only PreAccept is ever shed: it is the
+        sole request class that ADDS a txn to this replica — nacking
+        mid-protocol traffic (Commit/Apply/recovery/reads) would block the
+        very draining that lets load fall, and a shed there would leave the
+        txn's fate indeterminate.  A nacked PreAccept is safe: the
+        coordinator treats it like any replica failure (quorum from the
+        rest, or a CoordinationFailed the harness probes to a sound
+        resolution)."""
+        from ..messages.base import FailureReply, MessageType
+        if request.type is not MessageType.PRE_ACCEPT_REQ:
+            return False
+        if not self.admission.overloaded():
+            return False
+        self.admission.nacks += 1
+        self.overload_counters["nacks"] += 1
+        obs = self.observer
+        if obs is not None:
+            obs.registry.counter("overload.nacks", node=self.id).inc()
+        from ..coordinate.errors import Overloaded
+        self.message_sink.reply(from_node, reply_context, FailureReply(
+            Overloaded(getattr(request, "txn_id", None),
+                       f"node {self.id} shed by admission control")))
+        return True
 
     def _process_or_fail(self, request: "Request", from_node: int, reply_context,
                          failure: Optional[BaseException]) -> None:

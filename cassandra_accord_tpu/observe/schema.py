@@ -207,6 +207,8 @@ TIMELINE_POLICY_PREFIXES = {
     "progress.": "rate",
     "lifecycle.": "rate",
     "slo.": "rate",              # burn-rate monitor firings (observe/burnrate)
+    "overload.": "rate",         # admission nacks/sheds + retry-budget
+                                 # denials (local/overload.py, harness/burn)
     "audit.": "excluded",        # violation counters: forensic, not windowed
     "sim.": "excluded",          # pull-collected cluster.stats mirror
 }

@@ -1,0 +1,350 @@
+"""Overload robustness (ISSUE-17): admission control, retry budgets, and the
+metastable-failure oracles.
+
+Three planes under test:
+
+- primitives (``local/overload.py`` + ``backoff_timeout_us``): deterministic
+  hash jitter, token buckets, watermark hysteresis — property-pinned so the
+  EXACT arithmetic (including post-cap jitter) can never drift silently;
+- the burn harness with admission + budgets ON: open-loop hostile burns must
+  shed fast, resolve everything, check clean against the history oracle, and
+  stay deterministic with ZERO observer effect on the ``overload.*`` events;
+- the acceptance oracles (``run_overload_ramp`` / ``run_overload_burst`` and
+  the ``--overload`` CLI with its distinct exit code 4), small-scale in
+  tier-1 with the full-scale sweeps gated behind ACCORD_LONG_BURNS.
+"""
+import json
+import os
+
+import pytest
+
+from cassandra_accord_tpu.config import LocalConfig
+from cassandra_accord_tpu.harness.burn import (build_slo_specs,
+                                               main as burn_main,
+                                               run_burn,
+                                               run_overload_burst,
+                                               run_overload_ramp)
+from cassandra_accord_tpu.harness.cluster import backoff_timeout_us
+from cassandra_accord_tpu.local.overload import (AdmissionController,
+                                                 TokenBucket, hash_jitter)
+
+from dataclasses import replace
+
+HOSTILE = dict(chaos=True, allow_failures=True, durability=True,
+               journal=True, delayed_stores=True, clock_drift=True,
+               max_tasks=20_000_000)
+
+ADMISSION_CFG = replace(LocalConfig(), admission_enabled=True,
+                        retry_budget_enabled=True)
+
+
+# ---------------------------------------------------------------- primitives
+
+def test_backoff_timeout_us_properties():
+    # satellite 1: pin the ACTUAL backoff arithmetic.  Jitter is applied
+    # AFTER the max_s cap, so post-cap timeouts keep jittering upward in
+    # [cap, cap*(1+jitter_frac)) — that is load-bearing (capped re-arms
+    # across nodes must not phase-lock) and must not be "fixed".
+    base_s, factor, max_s, jf = 0.25, 2.0, 4.0, 0.2
+    for salt in (0, 1, 7, 12345, 2**63):
+        for attempt in range(12):
+            t = backoff_timeout_us(base_s, attempt, factor, max_s, jf, salt)
+            # deterministic: a pure function of its arguments
+            assert t == backoff_timeout_us(base_s, attempt, factor, max_s,
+                                           jf, salt)
+            capped = min(base_s * factor ** attempt, max_s)
+            assert t >= int(capped * 1e6)
+            assert t <= int(capped * (1.0 + jf) * 1e6)
+
+    # jitter_frac=0: exactly the capped exponential, monotone nondecreasing
+    prev = -1
+    for attempt in range(12):
+        t = backoff_timeout_us(base_s, attempt, factor, max_s, 0.0, 99)
+        assert t == int(min(base_s * factor ** attempt, max_s) * 1e6)
+        assert t >= prev
+        prev = t
+    assert prev == int(max_s * 1e6)   # the cap binds
+
+    # post-cap jitter: attempts past the cap still vary (per attempt AND per
+    # salt), so capped retries never phase-lock into a herd
+    capped_attempts = [backoff_timeout_us(base_s, a, factor, max_s, jf, 42)
+                      for a in range(8, 12)]
+    assert len(set(capped_attempts)) > 1
+    across_salts = [backoff_timeout_us(base_s, 10, factor, max_s, jf, s)
+                    for s in range(16)]
+    assert len(set(across_salts)) > 1
+
+
+def test_hash_jitter_bounded_and_deterministic():
+    vals = [hash_jitter(salt, n, 0.25)
+            for salt in (0, 3, 2**40) for n in range(64)]
+    assert all(-0.25 <= v < 0.25 for v in vals)
+    assert len(set(vals)) > 100          # actually spreads
+    assert hash_jitter(7, 3, 0.25) == hash_jitter(7, 3, 0.25)
+
+
+def test_token_bucket_grants_burst_then_denies_then_refills():
+    tb = TokenBucket(rate_per_s=2.0, burst=4.0, jitter_frac=0.0, salt=1)
+    assert all(tb.try_acquire(0.0) for _ in range(4))   # starts full
+    assert not tb.try_acquire(0.0)                      # empty -> denied
+    assert tb.denied == 1 and tb.granted == 4
+    assert tb.try_acquire(1.0)                          # 1s * 2/s = 2 tokens
+    assert tb.try_acquire(1.0)
+    assert not tb.try_acquire(1.0)
+    # refill never exceeds burst
+    assert tb.try_acquire(100.0)
+    assert tb.tokens <= tb.burst
+    # deterministic: a twin bucket fed the same calls agrees exactly
+    a = TokenBucket(rate_per_s=3.0, burst=5.0, jitter_frac=0.25, salt=9)
+    b = TokenBucket(rate_per_s=3.0, burst=5.0, jitter_frac=0.25, salt=9)
+    calls = [0.0, 0.1, 0.1, 0.5, 2.0, 2.0, 2.0, 9.0]
+    assert [a.try_acquire(t) for t in calls] == \
+        [b.try_acquire(t) for t in calls]
+    assert a.tokens == b.tokens
+
+
+class _StubStores:
+    def __init__(self):
+        self.stores = []
+
+    def all_stores(self):
+        return self.stores
+
+
+class _StubNode:
+    """Just enough node surface for AdmissionController: config, a sink with
+    ``callbacks``, command stores, and sim-time."""
+
+    def __init__(self, cfg):
+        self.config = cfg
+        self.message_sink = type("S", (), {"callbacks": {}})()
+        self.command_stores = _StubStores()
+        self._now = 0
+
+    def now_micros(self):
+        return self._now
+
+    def tick(self, callbacks: int):
+        # advance past the 100ms recompute bucket so load() re-reads
+        self._now += 200_000
+        self.message_sink.callbacks = {i: None for i in range(callbacks)}
+
+
+def test_admission_hysteresis():
+    cfg = replace(LocalConfig(), admission_enabled=True, admission_hi=10,
+                  admission_lo=4)
+    node = _StubNode(cfg)
+    adm = AdmissionController(node)
+    node.tick(9)
+    assert not adm.overloaded()          # below hi: admitting
+    node.tick(10)
+    assert adm.overloaded()              # at hi: starts shedding
+    node.tick(7)
+    assert adm.overloaded()              # between lo and hi: KEEPS shedding
+    node.tick(5)
+    assert adm.overloaded()              # still above lo
+    node.tick(4)
+    assert not adm.overloaded()          # at lo: readmits
+    node.tick(9)
+    assert not adm.overloaded()          # below hi again: no flap
+
+
+def test_admission_load_cached_within_bucket():
+    cfg = replace(LocalConfig(), admission_enabled=True)
+    node = _StubNode(cfg)
+    adm = AdmissionController(node)
+    node.tick(3)
+    assert adm.load() == 3
+    # mutate WITHOUT advancing sim-time: the 100ms cache holds
+    node.message_sink.callbacks = {i: None for i in range(50)}
+    assert adm.load() == 3
+    node.tick(50)
+    assert adm.load() == 50
+
+
+def test_overload_knobs_default_off():
+    cfg = LocalConfig()
+    assert cfg.admission_enabled is False
+    assert cfg.retry_budget_enabled is False
+    # a default-config burn builds no admission plane and counts nothing
+    res = run_burn(5, ops=30, concurrency=6, workload="openloop",
+                   rate_txn_s=40.0, **HOSTILE)
+    assert res.ops_shed == 0 and res.overload_nacks == 0
+    assert res.budget_denied == 0
+    assert "overload_nacks" not in res.stats
+    assert "ops_shed" not in res.stats
+
+
+# ------------------------------------------------- admission-enabled burns
+
+def test_admission_burn_sheds_and_checks_clean():
+    # the hostile matrix with admission + budgets ON at an overdriven rate:
+    # every op resolves (shed = fast client-visible FAILURE, sound because
+    # the txn is refused before a txn id exists), the history checks clean,
+    # and the shed/nack counters actually populate
+    res = run_burn(1, ops=120, concurrency=10, workload="openloop",
+                   rate_txn_s=60.0, node_config=ADMISSION_CFG,
+                   check="history", **HOSTILE)
+    assert res.resolved == 120
+    assert res.history is not None and res.history["anomalies"] == []
+    assert res.ops_shed + res.overload_nacks > 0
+    assert res.ops_failed >= res.ops_shed    # sheds surface as failed
+
+
+def test_admission_burn_is_deterministic():
+    from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+    kw = dict(ops=60, concurrency=8, workload="openloop", rate_txn_s=60.0,
+              node_config=ADMISSION_CFG, **HOSTILE)
+    ta, tb = Trace(), Trace()
+    a = run_burn(2, tracer=ta.hook, **kw)
+    b = run_burn(2, tracer=tb.hook, **kw)
+    assert diff_traces(ta, tb) is None
+    assert (a.ops_shed, a.overload_nacks, a.budget_denied) == \
+        (b.ops_shed, b.overload_nacks, b.budget_denied)
+
+
+def test_overload_events_have_zero_observer_effect():
+    # the PR-10 contract extended to overload.*: attaching a full recorder
+    # must not move a single event in an admission-enabled trajectory
+    from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+    from cassandra_accord_tpu.observe import FlightRecorder
+    kw = dict(ops=120, concurrency=10, workload="openloop", rate_txn_s=60.0,
+              node_config=ADMISSION_CFG, **HOSTILE)
+    ta, tb = Trace(), Trace()
+    run_burn(1, tracer=ta.hook, **kw)
+    rec = FlightRecorder()
+    run_burn(1, tracer=tb.hook, observer=rec, **kw)
+    assert diff_traces(ta, tb) is None
+    # and the observer actually SAW the overload plane
+    snap = rec.registry.snapshot()
+    assert any(name.startswith("overload.") and value > 0
+               for metrics in snap.values()
+               for name, value in metrics.items()
+               if not isinstance(value, dict))
+
+
+# ------------------------------------------------------- acceptance oracles
+
+def _oracle_kw(ops):
+    return dict(ops=ops, concurrency=10, node_config=ADMISSION_CFG,
+                check="history", **HOSTILE)
+
+
+def test_overload_ramp_small_scale_passes():
+    # tier-1 scale metastability ramp: 1x and 2x of a modest rate must hold
+    # the goodput floor with admission + budgets on (the full 0.5x..4x
+    # sweep is the ACCORD_LONG_BURNS soak below)
+    out = run_overload_ramp(1, _oracle_kw(60), 30.0, mults=(1.0, 2.0),
+                            frac=0.8)
+    assert out["passed"], out
+    assert out["capacity_goodput_txn_s"] > 0
+    assert out["goodput_floor_frac"] >= 0.8
+    assert [p["mult"] for p in out["points"]] == [1.0, 2.0]
+    # overload points actually exercised the defense
+    assert out["points"][1]["shed"] + out["points"][1]["nacks"] > 0
+
+
+def test_overload_burst_small_scale_recovers():
+    # burst-then-recover at tier-1 scale: post-burst goodput back to >= 80%
+    # of pre-burst, zero open SLO flags/burns at quiesce
+    out = run_overload_burst(1, _oracle_kw(200), 10.0, burst_mult=3.0,
+                             pre_s=6.0, burst_s=4.0, post_s=8.0, frac=0.8)
+    assert out["passed"], out
+    assert out["pre_goodput_txn_s"] > 0
+    assert out["post_goodput_txn_s"] >= 0.8 * out["pre_goodput_txn_s"]
+    assert out["slo_flags_open"] == 0 and out["open_slo_burns"] == 0
+
+
+def test_build_slo_specs():
+    # satellite 2: None when nothing is overridden (callers keep defaults)
+    assert build_slo_specs(None, None, None) is None
+    from cassandra_accord_tpu.observe.burnrate import DEFAULT_SLOS
+    specs = build_slo_specs(0.5, 0.1, "5:50")
+    assert specs is not None
+    defaults = {s.name: s for s in DEFAULT_SLOS}
+    for s in specs:
+        assert s.budget == 0.1
+        assert s.short_us == 5_000_000 and s.long_us == 50_000_000
+        if s.kind == "latency":
+            assert s.latency_slo_us == 500_000
+        else:
+            # non-latency specs keep their default threshold untouched
+            assert s.latency_slo_us == defaults[s.name].latency_slo_us
+    # latency override alone leaves liveness budget untouched
+    from cassandra_accord_tpu.observe.burnrate import DEFAULT_SLOS
+    only_lat = build_slo_specs(1.0, None, None)
+    assert {s.name: s.budget for s in only_lat} == \
+        {s.name: s.budget for s in DEFAULT_SLOS}
+    with pytest.raises(ValueError):
+        build_slo_specs(None, None, "nocolon")
+
+
+def test_overload_cli_ramp_pass_and_exit4_on_failure(tmp_path, monkeypatch):
+    # satellite 3: the --overload CLI ledgers a kind=overload record, emits
+    # shed/paced/budget-denied in --json, and distinguishes "survived but
+    # failed the acceptance bar" with exit code 4 (stalls stay exit 2)
+    ledger = tmp_path / "history.jsonl"
+    out_json = tmp_path / "overload.json"
+    monkeypatch.setenv("ACCORD_BENCH_HISTORY", str(ledger))
+    burn_main(["--seeds", "1", "--ops", "60", "--rate", "30",
+               "--overload", "ramp", "--overload-mults", "1,2",
+               "--check", "history", "--json", str(out_json)])
+    doc = json.loads(out_json.read_text())
+    (entry,) = doc["results"]
+    assert entry["status"] == "pass"
+    result = entry["result"]
+    assert result["passed"] is True
+    for point in result["points"]:
+        assert {"shed", "paced", "budget_denied"} <= set(point)
+    records = [json.loads(l) for l in ledger.read_text().splitlines()]
+    (rec,) = [r for r in records if r["kind"] == "overload"]
+    assert rec["metric"] == "goodput_floor_frac" and rec["passed"] is True
+    assert rec["capacity_goodput_txn_s"] > 0
+
+    # an impossible floor fraction: the cluster survives (no stall) but the
+    # acceptance bar fails -> exit code 4, status overload_failed
+    with pytest.raises(SystemExit) as exc:
+        burn_main(["--seeds", "1", "--ops", "60", "--rate", "30",
+                   "--overload", "ramp", "--overload-mults", "1,2",
+                   "--overload-frac", "5.0", "--check", "history",
+                   "--json", str(out_json)])
+    assert exc.value.code == 4
+    doc = json.loads(out_json.read_text())
+    assert doc["results"][0]["status"] == "overload_failed"
+
+
+def test_overload_cli_rejects_bad_combos():
+    with pytest.raises(SystemExit):
+        burn_main(["--seeds", "0", "--overload", "ramp",
+                   "--workload", "zipf"])
+    with pytest.raises(SystemExit):
+        burn_main(["--seeds", "0", "--overload", "ramp", "--reconcile"])
+    with pytest.raises(SystemExit):
+        burn_main(["--seeds", "0:2", "--overload", "ramp",
+                   "--parallel-seeds", "2"])
+
+
+# ------------------------------------------------------------------- soaks
+
+@pytest.mark.slow
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="hours-class: full overload sweeps")
+def test_overload_ramp_full_sweep():
+    out = run_overload_ramp(1, _oracle_kw(150), 30.0,
+                            mults=(0.5, 1.0, 2.0, 4.0), frac=0.8)
+    assert out["passed"], out
+
+
+@pytest.mark.slow
+@pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
+                    reason="hours-class: full overload sweeps")
+@pytest.mark.xfail(strict=False,
+                   reason="open find (KNOWN_ISSUES round 14): the full-scale "
+                          "burst trips commit.invalidate_conflict on an "
+                          "exclusive sync point at sim 255s; the oracle "
+                          "counts violations in its pass bar, so this fails "
+                          "until root-caused — flips to XPASS when fixed")
+def test_overload_burst_soak():
+    out = run_overload_burst(1, _oracle_kw(4500), 30.0, burst_mult=4.0,
+                             pre_s=30.0, burst_s=20.0, post_s=40.0, frac=0.8)
+    assert out["passed"], out

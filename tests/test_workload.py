@@ -133,6 +133,27 @@ def test_openloop_cli_ledgers_workload_slo(tmp_path, monkeypatch):
     assert slo[0]["slo_burn_events"] == 0
 
 
+def test_barrier_to_overloaded_coordinator_resolves_lost():
+    # ISSUE-17 satellite: an interactive barrier submitted while its
+    # coordinator is overloaded must RESOLVE — either a fast Overloaded
+    # CoordinationFailed or the control deadline — as ``lost``, never hang
+    # the burn.  Config pins every node permanently over the high watermark
+    # (hi=0, lo=-1: load >= 0 always, load <= -1 never), so every barrier
+    # in the multirange mix meets an overloaded coordinator.
+    from dataclasses import replace
+    from cassandra_accord_tpu.config import LocalConfig
+    cfg = replace(LocalConfig(), admission_enabled=True, admission_hi=0,
+                  admission_lo=-1)
+    w = MultiRangeWorkload()
+    res = run_burn(6, ops=60, concurrency=8, workload=w, node_config=cfg,
+                   **HOSTILE)
+    assert res.resolved == 60                # nothing hangs
+    assert w.counts.get("barrier", 0) > 0    # barriers were actually issued
+    # an always-shedding cluster cannot commit barriers: they land as lost
+    # (deadline or fast CoordinationFailed), and the run still quiesces
+    assert res.ops_lost + res.ops_failed + res.ops_shed > 0
+
+
 @pytest.mark.slow
 @pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
                     reason="hours-class: soak presets")

@@ -246,6 +246,32 @@ def trend_lines(entries: List[dict], last_k: int = 8,
                  f"/{w.get('sim_minutes')}min)" for w in same]
         lines.append(f"  workload_slo@{rate_cohort[0]}:"
                      f"{rate_cohort[1]}txn/s     " + " -> ".join(parts))
+    # the overload series (ISSUE-17 metastability oracles): goodput floor
+    # fraction (ramp) or recovery window (burst) per (mode, rate) cohort —
+    # a metastable regression shows as the floor cratering run-over-run.
+    # Sources: bench.py overload-stage embeds; burn CLI kind=overload
+    # records (--overload ramp|burst).
+    def _ovl(e):
+        if isinstance(e.get("overload"), dict):
+            return e["overload"]
+        if e.get("kind") == "overload":
+            return e
+        return None
+    ov_present = [(e, o) for e in window if (o := _ovl(e)) is not None]
+    if ov_present:
+        latest_o = ov_present[-1][1]
+        ov_cohort = (latest_o.get("mode"), latest_o.get("rate_txn_s"))
+        same = [o for _e, o in ov_present
+                if (o.get("mode"), o.get("rate_txn_s")) == ov_cohort]
+        parts = []
+        for o in same:
+            metric = o.get("goodput_floor_frac",
+                           o.get("recovery_sim_s", o.get("value")))
+            cap = o.get("capacity_goodput_txn_s")
+            parts.append(f"{'pass' if o.get('passed') else 'FAIL'}"
+                         f"({metric}" + (f"@{cap}txn/s" if cap else "") + ")")
+        lines.append(f"  overload@{ov_cohort[0]}:"
+                     f"{ov_cohort[1]}txn/s      " + " -> ".join(parts))
     # the protocol-throughput series: delta arrows across runs recording the
     # same ramp levels (a different concurrency ceiling is a different
     # measurement, like a different seed cohort)
