@@ -160,6 +160,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
              tracer=None, on_submit=None, consult_recorder=None,
              observer=None,
              profiler=None,
+             provenance=None,
+             perturb=None,
              audit: str = "off",
              audit_slo_s: Optional[float] = None,
              check: str = "off",
@@ -252,6 +254,17 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
     LoadSpikeNemesis (the overload ramp/burst presets).  Requires an
     open-loop workload.  Per-phase goodput lands in
     ``result.stats["load_phase{i}_ok"]``.
+
+    ``provenance``: an ``observe.ProvenanceRecorder`` — records the per-run
+    causal event DAG (observe/provenance.py) for divergence forensics and
+    violation slicing.  Attached to the observer (one is created if needed);
+    zero observer effect like every other attachment.
+
+    ``perturb``: a callable ``(cluster) -> None`` invoked once after cluster
+    construction — the mutation-test injection point (schedule an extra
+    fault-in, delay a timer).  It must not consume cluster RNG at call time,
+    so the trajectory stays byte-identical until the scheduled perturbation
+    fires.
     """
     from ..config import LocalConfig
     if audit not in ("off", "strict", "warn"):
@@ -266,13 +279,23 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
         from ..observe.audit import InvariantAuditor
         if observer is None:
             observer = InvariantAuditor(mode=audit,
-                                        slo_unattended_s=audit_slo_s)
+                                        slo_unattended_s=audit_slo_s,
+                                        provenance=provenance)
         elif isinstance(observer, InvariantAuditor):
             observer.mode = audit
         else:
             raise ValueError("audit requires the observer to be an "
                              "InvariantAuditor (or None — one is created); "
                              "got a plain FlightRecorder")
+    if provenance is not None:
+        if observer is None:
+            from ..observe import FlightRecorder
+            observer = FlightRecorder(record_messages=False,
+                                      provenance=provenance)
+        else:
+            # attach (idempotent for the auto-created auditor above): the
+            # cluster reads observer.provenance at construction
+            observer.provenance = provenance
     rng = RandomSource(seed)
     rf = rf if rf is not None else rng.pick([3, 3, 5])
     n_nodes = nodes if nodes is not None else rng.next_int(rf, 2 * rf)
@@ -326,6 +349,12 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
                       node_config=node_config,
                       observer=observer, profiler=profiler)
     cluster.tracer = tracer
+    if perturb is not None:
+        # mutation-test injection: the callable may only SCHEDULE work (an
+        # extra crash, a delayed timer) — the extra queue entry shifts later
+        # seq numbers uniformly, so the trajectory is untouched until the
+        # perturbation actually fires
+        perturb(cluster)
     if consult_recorder is not None:
         # trace-driven data-plane bench (harness/consult_trace.py): wrap every
         # store's resolver so the full mutation+query stream is captured
@@ -1079,7 +1108,8 @@ def run_burn(seed: int, ops: int = 200, concurrency: int = 10,
             from ..observe.checker import check_history
             result.history = check_history(
                 history_rec.ops, final_state=final,
-                spans=getattr(observer, "spans", None))
+                spans=getattr(observer, "spans", None),
+                provenance=getattr(observer, "provenance", None))
     except BaseException as e:  # noqa: BLE001
         if profiler is not None:
             try:
@@ -1169,13 +1199,14 @@ def build_slo_specs(latency_s=None, budget=None, windows=None):
     return tuple(specs)
 
 
-def _overload_observer(slo_specs):
+def _overload_observer(slo_specs, provenance=None):
     """Fresh warn-mode auditor + burn-rate monitor pair for one overload
     point (each burn needs its own: the monitors are stateful)."""
     from ..observe import BurnRateMonitor, InvariantAuditor
     monitor = BurnRateMonitor(specs=slo_specs) if slo_specs \
         else BurnRateMonitor()
-    return InvariantAuditor(mode="warn", burnrate=monitor), monitor
+    return InvariantAuditor(mode="warn", burnrate=monitor,
+                            provenance=provenance), monitor
 
 
 def _goodput(result) -> float:
@@ -1251,7 +1282,8 @@ def run_overload_ramp(seed: int, kw: dict, rate_txn_s: float,
 def run_overload_burst(seed: int, kw: dict, rate_txn_s: float,
                        burst_mult: float = 4.0, pre_s: float = 30.0,
                        burst_s: float = 20.0, post_s: float = 40.0,
-                       frac: float = 0.8, slo_specs=None) -> dict:
+                       frac: float = 0.8, slo_specs=None,
+                       provenance=None) -> dict:
     """The burst-then-recover oracle: one open-loop burn whose offered load
     steps 1x -> ``burst_mult`` -> 1x on the deterministic LoadSpikeNemesis
     schedule.  Pass iff post-burst goodput recovers to >= ``frac`` of
@@ -1265,8 +1297,10 @@ def run_overload_burst(seed: int, kw: dict, rate_txn_s: float,
                                 + post_s)), 50)
     kw2 = dict(kw, ops=ops, load_phases=phases)
     kw2.setdefault("workload", "openloop")
-    observer, monitor = _overload_observer(slo_specs)
+    observer, monitor = _overload_observer(slo_specs, provenance=provenance)
     kw2["observer"] = observer
+    if provenance is not None:
+        kw2["provenance"] = provenance
     r = run_burn(seed, rate_txn_s=rate_txn_s, **kw2)
     sim_s = r.sim_micros / 1e6
     pre_ok = r.stats.get("load_phase0_ok", 0)
@@ -1485,6 +1519,22 @@ def main(argv=None) -> None:
                    help="write the flight recorder's Chrome trace-event "
                         "JSON (open in Perfetto / chrome://tracing; one "
                         "track per node/store) after every seed")
+    p.add_argument("--provenance", default=None, metavar="PATH",
+                   help="record the causal event DAG (observe/provenance.py: "
+                        "every message/handler/timer/transition with its "
+                        "execution + message-chain parents) and write the "
+                        "dump after every seed (per-seed suffix on seed "
+                        "ranges).  Zero observer effect: the message trace "
+                        "stays byte-identical.  Audit violations, history "
+                        "anomalies and watchdog stall dumps gain bounded "
+                        "backward causal slices; --trace-out gains causal "
+                        "flow arrows")
+    p.add_argument("--explain-vs", default=None, metavar="PROV_JSON",
+                   help="divergence forensics: after the run, align this "
+                        "run's causal DAG against a reference --provenance "
+                        "dump and report the causally-first divergent event "
+                        "+ its ancestor cone (implies provenance recording; "
+                        "single seed only)")
     p.add_argument("--timeline-out", default=None, metavar="PATH",
                    help="write the sim-time windowed-telemetry JSONL "
                         "(observe/timeline.py: per-window commits/s + "
@@ -1565,13 +1615,19 @@ def main(argv=None) -> None:
         return f"{stem}.seed{seed}{ext or '.json'}"
 
     if args.reconcile and (args.metrics_out or args.trace_out or args.profile
-                           or args.timeline_out or args.burnrate):
+                           or args.timeline_out or args.burnrate
+                           or args.provenance or args.explain_vs):
         # reconcile runs two bare runs per seed and diffs them; a flight
         # recorder would conflate both into one recording — say so up front
         # instead of silently never writing the files
         print("warning: --metrics-out/--trace-out/--profile/--timeline-out/"
-              "--burnrate are ignored with --reconcile (no artifacts/"
-              "profiles will be produced)", flush=True)
+              "--burnrate/--provenance/--explain-vs are ignored with "
+              "--reconcile (no artifacts/profiles will be produced)",
+              flush=True)
+
+    if args.explain_vs and len(seeds) != 1 and not args.reconcile:
+        raise SystemExit("--explain-vs compares ONE run against ONE "
+                         f"reference dump (got --seeds {args.seeds})")
 
     if args.burnrate and args.audit == "off" and not args.reconcile:
         # the monitors' liveness plane burns on the auditor's SLO-flag
@@ -1664,6 +1720,15 @@ def main(argv=None) -> None:
             entry = {"seed": seed, "overload": args.overload,
                      "rate_txn_s": args.rate}
             summaries.append(entry)
+            prov = None
+            if args.provenance and args.overload == "burst":
+                # one recorder per burst burn; the ramp oracle runs several
+                # burns per point and would conflate them into one DAG
+                from ..observe import ProvenanceRecorder
+                prov = ProvenanceRecorder()
+            elif args.provenance and args.overload == "ramp":
+                print("warning: --provenance is ignored with --overload "
+                      "ramp (multi-burn schedule)", flush=True)
             try:
                 if args.overload == "ramp":
                     out = run_overload_ramp(
@@ -1672,10 +1737,13 @@ def main(argv=None) -> None:
                 else:
                     out = run_overload_burst(
                         seed, kw, args.rate, frac=args.overload_frac,
-                        slo_specs=slo_specs)
+                        slo_specs=slo_specs, provenance=prov)
             except SimulationException as e:
                 entry.update(status="fail", error=str(e.cause)[:2000],
                              wall_s=round(_time.perf_counter() - t0, 3))
+                if prov is not None:
+                    # the DAG up to the failure point IS the forensic artifact
+                    prov.save(artifact_path(args.provenance, seed))
                 write_json()
                 if isinstance(e.cause, StallError):
                     print(f"seed {seed}: STALL during --overload "
@@ -1686,6 +1754,8 @@ def main(argv=None) -> None:
                          "overload_failed",
                          wall_s=round(_time.perf_counter() - t0, 3),
                          result=out)
+            if prov is not None:
+                prov.save(artifact_path(args.provenance, seed))
             if args.overload == "ramp":
                 metric, value = ("goodput_floor_frac",
                                  out.get("goodput_floor_frac"))
@@ -1725,7 +1795,7 @@ def main(argv=None) -> None:
                              "--reconcile (run the sweep, replay failed "
                              "seeds singly)")
         if (args.metrics_out or args.trace_out or args.profile
-                or args.timeline_out):
+                or args.timeline_out or args.provenance or args.explain_vs):
             print("warning: per-seed artifacts are skipped under "
                   "--parallel-seeds (workers run observer-free)", flush=True)
         import multiprocessing as _mp
@@ -1780,6 +1850,11 @@ def main(argv=None) -> None:
             from ..observe import BurnRateMonitor
             monitor = BurnRateMonitor(specs=slo_specs) if slo_specs \
                 else BurnRateMonitor()
+        prov = None
+        if (args.provenance or args.explain_vs) and not args.reconcile:
+            from ..observe import ProvenanceRecorder
+            prov = ProvenanceRecorder()
+            kw["provenance"] = prov
         if args.audit != "off" and not args.reconcile:
             # the auditor IS a FlightRecorder, so it also serves
             # --metrics-out/--trace-out (reconcile runs construct their own
@@ -1789,14 +1864,14 @@ def main(argv=None) -> None:
             observer = InvariantAuditor(
                 mode=args.audit, slo_unattended_s=args.audit_slo,
                 record_messages=bool(args.trace_out or args.profile),
-                timeline=timeline, burnrate=monitor)
+                timeline=timeline, burnrate=monitor, provenance=prov)
             kw["observer"] = observer
             kw["audit"] = args.audit
         elif args.audit != "off" and args.reconcile:
             kw["audit"] = args.audit
             kw["audit_slo_s"] = args.audit_slo
         elif (args.metrics_out or args.trace_out or args.profile
-              or args.timeline_out or args.burnrate) \
+              or args.timeline_out or args.burnrate or prov is not None) \
                 and not args.reconcile:
             # flight recorder (reconcile runs its own two bare runs: the
             # recorder would conflate them, so it stays off there — warned
@@ -1806,7 +1881,7 @@ def main(argv=None) -> None:
             from ..observe import FlightRecorder
             observer = FlightRecorder(
                 record_messages=bool(args.trace_out or args.profile),
-                timeline=timeline, burnrate=monitor)
+                timeline=timeline, burnrate=monitor, provenance=prov)
             kw["observer"] = observer
         profiler = None
         if args.profile and not args.reconcile:
@@ -1817,7 +1892,10 @@ def main(argv=None) -> None:
             kw.update(progress_every_s=args.progress,
                       progress_label=f"seed {seed}")
 
-        def write_artifacts(observer=observer, seed=seed, profiler=profiler):
+        def write_artifacts(observer=observer, seed=seed, profiler=profiler,
+                            prov=prov):
+            if args.provenance and prov is not None:
+                prov.save(artifact_path(args.provenance, seed))
             if observer is None:
                 return
             import json as _json
@@ -1850,6 +1928,25 @@ def main(argv=None) -> None:
             entry["wall_profile"] = wall
             print(format_budget(budget, label=f"seed {seed}"), flush=True)
             print(format_wall_profile(wall, label=f"seed {seed}"), flush=True)
+
+        def explain_report(entry, prov=prov, seed=seed):
+            """--explain-vs: align this run's causal DAG against the
+            reference --provenance dump.  Prints the human forensics report
+            (causally-first divergent event + ancestor cone back to the
+            originating decision) and embeds the machine-readable core in
+            the --json entry.  Runs on success AND failure."""
+            if args.explain_vs is None or prov is None:
+                return
+            from ..observe import ProvenanceRecorder, explain_divergence
+            ref = ProvenanceRecorder.load(args.explain_vs)
+            rep = explain_divergence(ref, prov)
+            if rep is None:
+                entry["explain"] = None
+                print(f"seed {seed}: causal DAG identical to reference "
+                      f"{args.explain_vs}", flush=True)
+                return
+            entry["explain"] = {k: v for k, v in rep.items() if k != "text"}
+            print(rep["text"], flush=True)
         t0 = _time.perf_counter()
         entry = {"seed": seed, "rf": rf, "ops": args.ops}
         summaries.append(entry)
@@ -1910,6 +2007,7 @@ def main(argv=None) -> None:
                     _append_trend(slo_rec)
                     entry["workload_slo"] = slo_rec
                 profile_reports(entry)
+                explain_report(entry)
                 write_artifacts()
                 write_json()
                 print(f"seed {seed}: {result!r} (rf={rf}, "
@@ -1941,6 +2039,12 @@ def main(argv=None) -> None:
             # whatever was captured up to the failure point
             try:
                 profile_reports(entry)
+            except Exception:  # noqa: BLE001 — never mask the real failure
+                pass
+            try:
+                # forensics on the FAILED trajectory: where did this run
+                # causally depart from the reference?
+                explain_report(entry)
             except Exception:  # noqa: BLE001 — never mask the real failure
                 pass
             write_artifacts()

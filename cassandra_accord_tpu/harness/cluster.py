@@ -232,6 +232,10 @@ class NodeScheduler(Scheduler):
 
     def once(self, delay_s: float, run: Callable[[], None]):
         holder = {"cancelled": False}
+        prov = self.cluster._prov
+        # causal provenance: the timer's parent is the activity ARMING it;
+        # at fire time the bracket makes its sends/transitions children
+        armed_by = prov.current() if prov is not None else None
 
         def guarded():
             # stop-the-world pause: the timer is DUE but the process is not
@@ -244,7 +248,15 @@ class NodeScheduler(Scheduler):
             if entry is not None:
                 self._entries.discard(entry)
             if not holder["cancelled"] and self.is_live():
-                run()
+                if prov is not None:
+                    prov.begin_timer(self.node_id, armed_by,
+                                     self.cluster.queue.now_micros)
+                    try:
+                        run()
+                    finally:
+                        prov.end()
+                else:
+                    run()
 
         entry = self.cluster.queue.add_after(int(delay_s * 1_000_000), guarded)
         holder["e"] = entry
@@ -266,6 +278,8 @@ class NodeScheduler(Scheduler):
         late-fires at resume (a frozen process's periodic timer doesn't burst
         one fire per missed period)."""
         holder = {"parked": False}
+        prov = self.cluster._prov
+        armed_by = prov.current() if prov is not None else None
 
         def late_fire():
             holder["parked"] = False
@@ -278,7 +292,15 @@ class NodeScheduler(Scheduler):
                     self.cluster._gate(self.node_id, late_fire)
                 return
             if self.is_live():
-                run()
+                if prov is not None:
+                    prov.begin_timer(self.node_id, armed_by,
+                                     self.cluster.queue.now_micros)
+                    try:
+                        run()
+                    finally:
+                        prov.end()
+                else:
+                    run()
             elif holder.get("s") is not None:
                 holder["s"].cancel()
 
@@ -451,6 +473,11 @@ class SimMessageSink(MessageSink):
             # hang)
             self.callbacks[msg_id] = (callback, timeout_entry, to, attempt,
                                       now, tid)
+        prov = self.cluster._prov
+        if prov is not None:
+            # causal bracket: sends the callback makes are children of this
+            # reply delivery (which chains back to the original request)
+            prov.begin_callback(self.node_id, msg_id, tid, now)
         try:
             if isinstance(reply, FailureReply):
                 callback.on_failure(from_node, reply.failure)
@@ -458,6 +485,9 @@ class SimMessageSink(MessageSink):
                 callback.on_success(from_node, reply)
         except BaseException as e:  # noqa: BLE001
             callback.on_callback_failure(from_node, e)
+        finally:
+            if prov is not None:
+                prov.end()
 
     def report_failure(self, msg_id: int, to_node: int, failure: BaseException) -> None:
         if self.cluster._gate(self.node_id, lambda: self.report_failure(
@@ -488,10 +518,19 @@ class SimMessageSink(MessageSink):
         if self.cluster.observer is not None:
             self.cluster.observer.on_reply_timeout(
                 self.node_id, to, tid, self.cluster.queue.now_micros)
+        prov = self.cluster._prov
+        if prov is not None:
+            # causal bracket: retries/failure handling this timeout launches
+            # chain back (via msg_id) to the send that went unanswered
+            prov.begin_timeout(self.node_id, msg_id, tid,
+                               self.cluster.queue.now_micros)
         try:
             callback.on_failure(to, Timeout(None, f"no reply from {to}"))
         except BaseException as e:  # noqa: BLE001
             callback.on_callback_failure(to, e)
+        finally:
+            if prov is not None:
+                prov.end()
 
 
 class ReplyContext:
@@ -657,6 +696,12 @@ class Cluster:
         # fed from the same sites as the tracer plus the lifecycle planes;
         # MUST have zero observer effect (no RNG, no wall clock, no scheduling)
         self.observer = observer
+        # causal provenance recorder (observe/provenance.py), riding the
+        # observer: the execution-context brackets below (reply callbacks,
+        # timeouts, node timers, crash/restart) feed it directly — pure
+        # bookkeeping, same zero-observer-effect contract as the observer
+        self._prov = getattr(observer, "provenance", None) \
+            if observer is not None else None
         # wall-clock profiler (observe.WallProfiler): times handler CPU and
         # event-loop occupancy.  Reads wall clocks ONLY — it must never
         # touch RNG, sim scheduling, or the message path, so the recorder
@@ -954,6 +999,10 @@ class Cluster:
         # purge the request-coalescing inbox (those messages were in RAM)
         self._inboxes.pop(node_id, None)
         self._inbox_drain_at.pop(node_id, None)
+        if self._prov is not None:
+            # fault-ins are first-class causal events: an injected crash is
+            # often the true origin of a divergence yet emits no trace byte
+            self._prov.on_crash(node_id, self.queue.now_micros)
         if self.observer is not None:
             # the auditor re-baselines the node's lifecycle state here: the
             # journal replay at restart legitimately re-observes commands at
@@ -1080,6 +1129,8 @@ class Cluster:
             self.queue.add_after(1, relaunch)
         for hook in list(self.on_restart_hooks):
             hook(node)
+        if self._prov is not None:
+            self._prov.on_restart(node_id, self.queue.now_micros)
         if self.observer is not None:
             # replay is complete: the auditor resumes normal edge checking
             # for this node (post-restart traffic takes live paths again)

@@ -34,6 +34,8 @@ def dump_wait_state(cluster: Cluster) -> str:
     frontier.  Names every blocked txn id and what it waits on."""
     from ..local.status import SaveStatus
     lines: List[str] = []
+    stall_roots: List[tuple] = []   # (txn_id, node, store) slice anchors —
+                                    # the oldest blocked txn per store
     stalled = sorted(n for n in cluster.nodes
                      if cluster.journal is not None
                      and cluster.journal.is_stalled(n))
@@ -61,6 +63,8 @@ def dump_wait_state(cluster: Cluster) -> str:
                 f"pending_bootstrap={store.pending_bootstrap!r} "
                 f"stale={cluster.stores[node_id].stale_ranges!r}")
             blocked.sort(key=lambda p: p[0])
+            if blocked:
+                stall_roots.append((blocked[0][0], node_id, store.id))
             for txn_id, cmd in blocked[:_MAX_BLOCKED_PER_STORE]:
                 waits = sorted(cmd.waiting_on.waiting)
                 lines.append(
@@ -128,6 +132,25 @@ def dump_wait_state(cluster: Cluster) -> str:
                     default=str))
             except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
                 lines.append(f"timeline: <error {e!r}>")
+        # provenance section (observe/provenance.py): the bounded backward
+        # causal slice of each store's oldest blocked txn — how the wedge
+        # was REACHED (handlers, timers, timeouts), not just what it waits on
+        prov = getattr(observer, "provenance", None)
+        if prov is not None:
+            import json as _json
+            try:
+                slices = {}
+                for txn_id, node_id, store_id in stall_roots[:4]:
+                    sl = prov.slice_for(txn_id=txn_id, node=node_id,
+                                        store=store_id)
+                    if sl is not None:
+                        slices[str(txn_id)] = sl
+                lines.append("provenance: " + _json.dumps(
+                    {"stall_root_slices": slices,
+                     "tail": prov.tail_summary()}, sort_keys=True,
+                    default=str))
+            except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
+                lines.append(f"provenance: <error {e!r}>")
     return "\n".join(lines)
 
 

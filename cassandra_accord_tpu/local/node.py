@@ -322,12 +322,24 @@ class Node(ConfigurationService.Listener):
             return
         profiler = self.profiler
         t_start = profiler.now() if profiler is not None else 0.0
+        prov = getattr(self.observer, "provenance", None) \
+            if self.observer is not None else None
+        if prov is not None:
+            # causal bracket: sends/transitions this handler makes become
+            # children of the handler event, itself a child of the delivery
+            # (RECV) that triggered it — pure bookkeeping, zero observer
+            # effect like the profiler bracket below
+            prov.begin_handler(self.id, type(request).__name__,
+                               getattr(request, "txn_id", None),
+                               self._now_micros())
         try:
             request.process(self, from_node, reply_context)
         except BaseException as e:  # noqa: BLE001 — must reply so the caller unblocks
             self.agent.on_handled_exception(e)
             self.message_sink.reply_with_unknown_failure(from_node, reply_context, e)
         finally:
+            if prov is not None:
+                prov.end()
             if profiler is not None:
                 # per-message-type handler CPU (wall plane): measured around
                 # the replica-side state machine, attributed to the txn so

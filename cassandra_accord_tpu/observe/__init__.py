@@ -27,6 +27,8 @@ from .timeline import (Timeline, commits_per_sec_series, exact_percentile,
 from .burnrate import BurnRateMonitor, SloSpec
 from .history import HistoryOp, HistoryRecorder
 from .checker import HistoryAnomaly, check_history, format_report
+from .provenance import (ProvenanceRecorder, explain_divergence,
+                         render_slice)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
@@ -41,4 +43,5 @@ __all__ = [
     "BurnRateMonitor", "SloSpec",
     "HistoryOp", "HistoryRecorder",
     "HistoryAnomaly", "check_history", "format_report",
+    "ProvenanceRecorder", "explain_divergence", "render_slice",
 ]

@@ -71,7 +71,8 @@ class AuditViolation(Exception):
     def __init__(self, rule: str, detail: str, txn_id=None,
                  node: Optional[int] = None, store: Optional[int] = None,
                  now_us: Optional[int] = None, timeline: Optional[dict] = None,
-                 registry: Optional[dict] = None):
+                 registry: Optional[dict] = None,
+                 causal_slice: Optional[dict] = None):
         where = " ".join(
             part for part in (
                 f"txn {txn_id}" if txn_id is not None else None,
@@ -88,6 +89,9 @@ class AuditViolation(Exception):
         self.now_us = now_us
         self.timeline = timeline
         self.registry = registry
+        # bounded k-hop backward causal slice of the bad event (the ancestor
+        # cone from observe/provenance.py), when a recorder was attached
+        self.causal_slice = causal_slice
 
     def report(self, include_registry: bool = False) -> dict:
         out = {
@@ -99,6 +103,8 @@ class AuditViolation(Exception):
             "sim_us": self.now_us,
             "timeline": self.timeline,
         }
+        if self.causal_slice is not None:
+            out["causal_slice"] = self.causal_slice
         if include_registry:
             out["registry"] = self.registry
         return out
@@ -136,11 +142,12 @@ class InvariantAuditor(FlightRecorder):
                  slo_unapplied_s: Optional[float] = None,
                  message_ring: Optional[int] = None,
                  record_messages: bool = False,
-                 timeline=None, burnrate=None):
+                 timeline=None, burnrate=None, provenance=None):
         assert mode in ("strict", "warn"), f"bad audit mode {mode!r}"
         super().__init__(message_ring=message_ring,
                          record_messages=record_messages,
-                         timeline=timeline, burnrate=burnrate)
+                         timeline=timeline, burnrate=burnrate,
+                         provenance=provenance)
         self.mode = mode
         # single source for the SLO ladder: call sites pass the user value
         # through (None = default), and the decision/apply budgets default to
@@ -221,10 +228,17 @@ class InvariantAuditor(FlightRecorder):
         span = self.spans.spans.get(txn_id) if txn_id is not None else None
         if span is not None:
             timeline = span.to_dict()
+        causal_slice = None
+        if self.provenance is not None:
+            # the bad event's bounded backward cone — walked NOW, while the
+            # recorder still points at the transition that tripped the rule
+            causal_slice = self.provenance.slice_for(
+                txn_id=txn_id, node=node, store=store)
         violation = AuditViolation(rule, detail, txn_id=txn_id, node=node,
                                    store=store, now_us=now_us,
                                    timeline=timeline,
-                                   registry=self.registry.snapshot())
+                                   registry=self.registry.snapshot(),
+                                   causal_slice=causal_slice)
         self.violations.append(violation)
         self.registry.counter(f"audit.violation.{rule}").inc()
         if self.mode == "strict":

@@ -83,6 +83,9 @@ def format_report(report: dict, max_ops: int = 12) -> str:
     if a.get("timelines"):
         lines.append(f"  flight-recorder timelines attached for "
                      f"{sorted(a['timelines'])}")
+    if a.get("causal_slices"):
+        lines.append(f"  causal slices attached for "
+                     f"{sorted(a['causal_slices'])}")
     more = len(anomalies) - 1
     if more:
         lines.append(f"  (+{more} further anomalies in report)")
@@ -124,16 +127,19 @@ def _classify(edges: List[dict]) -> Tuple[str, str]:
 
 def check_history(ops, final_state: Optional[Dict] = None, spans=None,
                   raise_on_anomaly: bool = True,
-                  max_anomalies: int = 8) -> dict:
+                  max_anomalies: int = 8, provenance=None) -> dict:
     """Check a list of ``HistoryOp`` for strict serializability.
 
     ``final_state``: authoritative key -> version tuple (e.g. the burn's
     replica-agreement snapshot); enables lost-update detection and extends
     per-key orders beyond what reads observed.  ``spans``: a
     ``TxnSpanRecorder`` (or FlightRecorder ``.spans``) for timeline
-    attachment.  Returns the report; raises :class:`HistoryAnomaly` on the
-    first anomaly unless ``raise_on_anomaly=False`` (then the report carries
-    up to ``max_anomalies`` of them).
+    attachment.  ``provenance``: a ``ProvenanceRecorder`` — each anomaly
+    then carries a bounded backward causal slice per implicated txn
+    (``causal_slices``), the "how did the protocol get here" attachment.
+    Returns the report; raises :class:`HistoryAnomaly` on the first anomaly
+    unless ``raise_on_anomaly=False`` (then the report carries up to
+    ``max_anomalies`` of them).
     """
     anomalies: List[dict] = []
     considered = [op for op in ops if op.outcome != "fail"]
@@ -155,6 +161,16 @@ def check_history(ops, final_state: Optional[Dict] = None, spans=None,
                     tl[str(op.txn_id)] = span.to_dict()
             if tl:
                 a["timelines"] = tl
+        if provenance is not None:
+            slices = {}
+            for op in implicated:
+                if op.txn_id is None:
+                    continue
+                sl = provenance.slice_for(txn_id=op.txn_id)
+                if sl is not None:
+                    slices[str(op.txn_id)] = sl
+            if slices:
+                a["causal_slices"] = slices
         anomalies.append(a)
         return a
 

@@ -195,6 +195,68 @@ def wall_profile_events(recorder, profiler) -> List[dict]:
     return events
 
 
+def causal_flow_events(recorder) -> List[dict]:
+    """Causal-edge flow tracks from an attached ``ProvenanceRecorder``:
+
+    - one flow per message id chaining its wire lifecycle (send -> RECV ->
+      reply -> RECV_RPLY) across the node tracks, so a delivery is clickable
+      back to its send;
+    - one flow per audit-violation causal slice (cap 8), threading the
+      violation's ancestor cone through the handler/lifecycle tracks it
+      touched — the cone IS the clickable path in the timeline UI.
+
+    Derived entirely at export time from the provenance side table: zero
+    runtime cost, nothing emitted when no recorder rode the run."""
+    prov = getattr(recorder, "provenance", None)
+    if prov is None:
+        return []
+    from .provenance import E_FRM, E_KIND, E_MSG, E_TO, E_US, K_MSG, \
+        K_TRANSITION
+
+    def track(ev):
+        if ev[E_KIND] == K_MSG:
+            return (ev[E_FRM] if ev[E_FRM] is not None else 0), 0
+        if ev[E_KIND] == K_TRANSITION:
+            # transition tuples carry store in the FRM slot (see provenance)
+            return ev[E_TO], (ev[E_FRM] or 0) + 1
+        return (ev[E_TO] if ev[E_TO] is not None else 0), 0
+
+    events: List[dict] = []
+    chains: dict = {}
+    for ev in prov.events:
+        if ev[E_KIND] == K_MSG and ev[E_MSG] is not None:
+            chains.setdefault(ev[E_MSG], []).append(ev)
+    for msg_id, chain in chains.items():
+        if len(chain) < 2:
+            continue
+        flow_id = f"cause-msg-{msg_id}"
+        for j, ev in enumerate(chain):
+            ph = "s" if j == 0 else ("f" if j + 1 == len(chain) else "t")
+            pid, tid = track(ev)
+            e = {"name": "causal", "cat": "causal", "ph": ph, "id": flow_id,
+                 "ts": ev[E_US], "pid": pid, "tid": tid,
+                 "args": {"msg_id": msg_id}}
+            if ph == "f":
+                e["bp"] = "e"
+            events.append(e)
+    for k, violation in enumerate(getattr(recorder, "violations", ())[:8]):
+        sl = getattr(violation, "causal_slice", None)
+        if not sl or len(sl["events"]) < 2:
+            continue
+        flow_id = f"slice-{violation.rule}-{k}"
+        cone = [prov.events[d["pid"]] for d in sl["events"]]
+        for j, ev in enumerate(cone):
+            ph = "s" if j == 0 else ("f" if j + 1 == len(cone) else "t")
+            pid, tid = track(ev)
+            e = {"name": "violation-slice", "cat": "causal", "ph": ph,
+                 "id": flow_id, "ts": ev[E_US], "pid": pid, "tid": tid,
+                 "args": {"rule": violation.rule}}
+            if ph == "f":
+                e["bp"] = "e"
+            events.append(e)
+    return events
+
+
 def _span_events(span) -> List[dict]:
     events: List[dict] = []
     tid_str = str(span.txn_id)
@@ -259,6 +321,10 @@ def chrome_trace(recorder, include_messages: bool = True,
         pids.add(COUNTER_PID)
         tids.add((COUNTER_PID, 2))
         events.extend(tl_counters)
+    for ev in causal_flow_events(recorder):
+        pids.add(ev["pid"])
+        tids.add((ev["pid"], ev["tid"]))
+        events.append(ev)
     if include_messages:
         for seq, ts, event, frm, to, msg_id, brief in recorder.messages:
             pids.add(frm)
@@ -314,6 +380,8 @@ def validate_chrome_trace(doc) -> List[str]:
     events = doc["traceEvents"]
     if not isinstance(events, list):
         return ["traceEvents is not a list"]
+    flow_starts = set()
+    flow_ends: List[tuple] = []
     for i, ev in enumerate(events):
         ctx = f"event[{i}]"
         if not isinstance(ev, dict):
@@ -332,8 +400,13 @@ def validate_chrome_trace(doc) -> List[str]:
             dur = ev.get("dur")
             if not isinstance(dur, int) or dur <= 0:
                 problems.append(f"{ctx}: X event needs a positive int dur")
-        if ph in _FLOW_PHASES and not ev.get("id"):
-            problems.append(f"{ctx}: flow event ({ph}) needs an id")
+        if ph in _FLOW_PHASES:
+            if not ev.get("id"):
+                problems.append(f"{ctx}: flow event ({ph}) needs an id")
+            elif ph == "s":
+                flow_starts.add(ev["id"])
+            elif ph == "f":
+                flow_ends.append((i, ev["id"]))
         if ph == "C":
             args = ev.get("args")
             if not isinstance(args, dict) or not args:
@@ -349,4 +422,13 @@ def validate_chrome_trace(doc) -> List[str]:
         if len(problems) > 20:
             problems.append("... (truncated)")
             break
+    # flow pairing: a finish (f) with no start (s) of the same id renders as
+    # a dangling arrow in Perfetto — an id alone is not enough
+    for i, flow_id in flow_ends:
+        if flow_id not in flow_starts:
+            problems.append(f"event[{i}]: flow finish id {flow_id!r} has no "
+                            f"matching start")
+            if len(problems) > 24:
+                problems.append("... (truncated)")
+                break
     return problems

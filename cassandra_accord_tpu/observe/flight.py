@@ -39,10 +39,15 @@ class FlightRecorder:
 
     def __init__(self, message_ring: Optional[int] = None,
                  record_messages: bool = True,
-                 timeline=None, burnrate=None):
+                 timeline=None, burnrate=None, provenance=None):
         self.registry = MetricsRegistry()
         self.spans = TxnSpanRecorder()
         self.record_messages = record_messages
+        # causal provenance side table (observe/provenance.py): the per-run
+        # event DAG divergence forensics and violation slicing walk.  Pure
+        # bookkeeping on already-computed values — same zero-observer-effect
+        # contract as every other attachment here.
+        self.provenance = provenance
         # sim-time windowed telemetry (observe/timeline.py): counters become
         # per-window rates, gauges samples, latencies per-window percentiles.
         # Same zero-observer-effect contract as every other plane here.
@@ -102,6 +107,9 @@ class FlightRecorder:
                 tl.count("msg.received", now_us, node=to)
         if self.record_messages:
             self._message_trace.hook(event, frm, to, msg_id, message, now_us)
+        if self.provenance is not None:
+            self.provenance.on_message_event(event, frm, to, msg_id, message,
+                                             now_us)
         if self.burnrate is not None:
             # clock pulse: a total wedge produces no resolutions, but probes
             # and timeouts keep the message plane (and so the monitors) live
@@ -195,6 +203,9 @@ class FlightRecorder:
         decision state (executeAt, deps, ballots, watermarks) passively;
         the recorder itself only uses the scalar fields."""
         self.spans.on_transition(node, store, txn_id, status_name, now_us)
+        if self.provenance is not None:
+            self.provenance.on_transition(node, store, txn_id, status_name,
+                                          now_us)
         name = schema.metric_for_save_status(status_name)
         self.registry.counter(name).inc()
         self.registry.counter(name, node=node, store=store).inc()

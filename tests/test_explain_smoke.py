@@ -1,0 +1,80 @@
+"""tools/explain.py stdout TAIL contract (tier-1).
+
+Same harness contract as bench.py / tools/trend.py (the bounded tail
+capture parses the LAST stdout line as one compact JSON object): pinned
+here on canned provenance dumps so the smoke stays sub-second — no burn
+runs in-process; the dumps are synthesized with the recorder API.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cassandra_accord_tpu.observe import ProvenanceRecorder
+
+EXPLAIN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "explain.py")
+
+
+def _dump(path, crash_at=None):
+    """A small synthetic run: send/recv/handler/transition chain, with an
+    optional injected crash event (the divergence under test)."""
+    prov = ProvenanceRecorder()
+    for i in range(8):
+        us = 100 * (i + 1)
+        if crash_at == i:
+            prov.on_crash(2, us)
+        prov.on_message_event("SEND", 1, 2, i, None, us)
+        prov.on_message_event("RECV", 1, 2, i, None, us + 10)
+        prov.begin_handler(2, "PreAccept", f"t{i}", us + 10)
+        prov.on_transition(2, 0, f"t{i}", "PRE_ACCEPTED", us + 10)
+        prov.end()
+    prov.save(str(path))
+    return prov
+
+
+@pytest.fixture()
+def dumps(tmp_path):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    _dump(a)
+    _dump(b, crash_at=4)
+    return str(a), str(b)
+
+
+def _run(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable, EXPLAIN, *argv],
+                          capture_output=True, text=True, timeout=120,
+                          env=env, cwd=os.path.dirname(os.path.dirname(EXPLAIN)))
+
+
+def test_divergent_tail_is_single_json_object(dumps):
+    a, b = dumps
+    proc = _run(a, b)
+    assert proc.returncode == 3, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    assert lines, "explain printed nothing"
+    tail = json.loads(lines[-1])          # the harness's parse, exactly
+    assert isinstance(tail, dict)
+    assert tail["identical"] is False
+    assert tail["event_b"]["kind"] == "crash"
+    assert isinstance(tail["index"], int)
+    assert tail["cone_events"] >= 1
+    # sized to survive a bounded tail capture
+    assert len(lines[-1]) < 4096
+    # the human report precedes the tail
+    assert any("causal divergence" in l for l in lines[:-1])
+
+
+def test_identical_tail_and_exit_zero(dumps):
+    a, _b = dumps
+    proc = _run(a, a)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    tail = json.loads(lines[-1])
+    assert tail["identical"] is True
+    assert tail["events_a"] == tail["events_b"]
+    assert len(lines[-1]) < 4096

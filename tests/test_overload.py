@@ -339,11 +339,13 @@ def test_overload_ramp_full_sweep():
 @pytest.mark.skipif("ACCORD_LONG_BURNS" not in os.environ,
                     reason="hours-class: full overload sweeps")
 @pytest.mark.xfail(strict=False,
-                   reason="open find (KNOWN_ISSUES round 14): the full-scale "
-                          "burst trips commit.invalidate_conflict on an "
-                          "exclusive sync point at sim 255s; the oracle "
-                          "counts violations in its pass bar, so this fails "
-                          "until root-caused — flips to XPASS when fixed")
+                   reason="open find (KNOWN_ISSUES round 15): on the "
+                          "committed tree the PR-17 invalidate_conflict "
+                          "claim does NOT reproduce (0 violations); the "
+                          "soak instead fails the 0.8 recovery bar — "
+                          "post-burst goodput 0.147x of pre over a ~615 "
+                          "sim-s CheckStatus probe-storm drain tail — "
+                          "flips to XPASS when root-caused")
 def test_overload_burst_soak():
     out = run_overload_burst(1, _oracle_kw(4500), 30.0, burst_mult=4.0,
                              pre_s=30.0, burst_s=20.0, post_s=40.0, frac=0.8)

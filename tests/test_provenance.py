@@ -1,0 +1,322 @@
+"""Causal provenance tracing & divergence forensics (observe/provenance.py).
+
+Three hard contracts:
+
+1. ZERO OBSERVER EFFECT: a same-seed hostile burn with the provenance
+   recorder ON vs OFF yields byte-identical full message traces
+   (``diff_traces`` is None) and identical outcome counters — the PR-3
+   proof, extended to the causal side table.
+2. MUTATION LOCALIZATION: a single seeded perturbation (an injected crash,
+   a delayed timer-shaped fault-in) between two otherwise-identical runs is
+   named by ``explain_divergence`` as the causally-FIRST divergent event —
+   not merely the first differing message byte, which lands later — and the
+   injected event is inside the report's ancestor cone.
+3. VIOLATION SLICING: every strict-mode ``AuditViolation`` raised with a
+   provenance recorder attached carries a bounded backward causal slice
+   whose anchor is the transition that tripped the rule.
+"""
+import json
+
+import pytest
+
+from cassandra_accord_tpu.harness.burn import run_burn
+from cassandra_accord_tpu.harness.trace import Trace, diff_traces
+from cassandra_accord_tpu.observe import (AuditViolation, FlightRecorder,
+                                          InvariantAuditor,
+                                          ProvenanceRecorder,
+                                          explain_divergence, render_slice,
+                                          validate_chrome_trace)
+from cassandra_accord_tpu.observe import rules
+from cassandra_accord_tpu.observe.provenance import (E_KIND, E_P1, E_P2,
+                                                     E_PID, E_US, K_CRASH,
+                                                     K_HANDLER, K_MSG,
+                                                     K_TIMER, K_TRANSITION)
+from cassandra_accord_tpu.primitives.timestamp import (Domain, TxnId,
+                                                       TxnKind)
+
+HOSTILE = dict(ops=40, concurrency=8, chaos=True, allow_failures=True,
+               durability=True, journal=True, delayed_stores=True,
+               clock_drift=True, max_tasks=3_000_000)
+
+# the mutation regime: no chaos nemesis, so every node is guaranteed live
+# at the injection time and the ONLY difference between run a and run b is
+# the perturbation itself
+QUIET = dict(ops=80, concurrency=8, chaos=False, allow_failures=True,
+             durability=True, journal=True, max_tasks=3_000_000)
+
+
+def tid(hlc: int, node: int = 1) -> TxnId:
+    return TxnId(epoch=1, hlc=hlc, node=node, kind=TxnKind.WRITE,
+                 domain=Domain.KEY)
+
+
+# ---------------------------------------------------------------------------
+# the tentpole invariant: zero observer effect
+# ---------------------------------------------------------------------------
+
+def test_zero_observer_effect_hostile():
+    """Same-seed hostile burn with provenance ON vs OFF: identical full
+    message traces and identical outcomes — recording the causal DAG never
+    perturbs the simulation."""
+    ta, tb = Trace(), Trace()
+    bare = run_burn(9, tracer=ta.hook, **HOSTILE)
+    prov = ProvenanceRecorder()
+    observed = run_burn(9, tracer=tb.hook, provenance=prov, **HOSTILE)
+    divergence = diff_traces(ta, tb)
+    assert divergence is None, \
+        f"provenance recorder perturbed the simulation:\n{divergence}"
+    assert (bare.ops_ok, bare.ops_recovered, bare.ops_nacked, bare.ops_lost,
+            bare.ops_failed, bare.sim_micros) == \
+           (observed.ops_ok, observed.ops_recovered, observed.ops_nacked,
+            observed.ops_lost, observed.ops_failed, observed.sim_micros)
+    # the side table is keyed by trace seq: one entry per traced message
+    # event, each pointing at a msg-kind DAG node
+    assert len(prov.seq_to_pid) == len(tb.events)
+    assert all(prov.events[p][E_KIND] == K_MSG for p in prov.seq_to_pid)
+    # the DAG is a strict superset of the message plane: handler executions
+    # and save-status transitions are first-class events
+    kinds = {ev[E_KIND] for ev in prov.events}
+    assert {K_MSG, K_HANDLER, K_TRANSITION, K_TIMER} <= kinds
+    # parent edges are well-formed: strictly backward, in range
+    for ev in prov.events:
+        for parent in (ev[E_P1], ev[E_P2]):
+            if parent is not None:
+                assert 0 <= parent < ev[E_PID]
+
+
+def test_provenance_on_vs_off_same_causal_dag(tmp_path):
+    """Two same-seed runs with provenance on both sides build the SAME DAG
+    (content-wise), and save/load round-trips it."""
+    pa, pb = ProvenanceRecorder(), ProvenanceRecorder()
+    run_burn(11, provenance=pa, **HOSTILE)
+    run_burn(11, provenance=pb, **HOSTILE)
+    assert explain_divergence(pa, pb) is None
+    path = tmp_path / "prov.json"
+    pa.save(str(path))
+    doc = ProvenanceRecorder.load(str(path))
+    assert doc["version"] == 1 and len(doc["events"]) == len(pa.events)
+    # a loaded doc aligns against a live recorder
+    assert explain_divergence(doc, pb) is None
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"version": 99}))
+        ProvenanceRecorder.load(str(bad))
+
+
+# ---------------------------------------------------------------------------
+# mutation checks: the explainer localizes an injected perturbation
+# ---------------------------------------------------------------------------
+
+def test_explain_localizes_injected_crash():
+    """Run b = run a + one crash injected at sim 2s (restart at 5s keeps the
+    burn live).  The crash emits NO message-trace byte at injection time, so
+    a byte-level diff can only see downstream symptoms — the causal
+    explainer must name the crash itself as the first divergent event."""
+    crash_us = 2_000_000
+    pa, pb = ProvenanceRecorder(), ProvenanceRecorder()
+    run_burn(7, provenance=pa, **QUIET)
+
+    def perturb(cluster):
+        cluster.queue.add_after(crash_us, lambda: cluster.crash(2))
+        cluster.queue.add_after(5_000_000, lambda: cluster.restart(2))
+
+    run_burn(7, provenance=pb, perturb=perturb, **QUIET)
+    rep = explain_divergence(pa, pb)
+    assert rep is not None, "injected crash produced no divergence"
+    # the causally-first divergent event IS the injection, at its exact
+    # injection time
+    assert rep["event_b"]["kind"] == K_CRASH
+    assert rep["event_b"]["sim_us"] == crash_us
+    assert "crash node2" in rep["event_b"]["what"]
+    # the ancestor cone reaches the injection point
+    assert any(d["kind"] == K_CRASH and d["sim_us"] == crash_us
+               for d in rep["cone"])
+    # the byte-level symptom is NOT the explanation: the first differing
+    # message event (if the traces differ at all) is a downstream
+    # consequence at-or-after the injection, and is never a crash
+    msg = rep["first_message_divergence"]
+    if msg is not None:
+        for side in ("event_a", "event_b"):
+            if side in msg:
+                assert msg[side]["sim_us"] >= crash_us
+                assert msg[side]["kind"] == K_MSG
+    assert "causal divergence" in rep["text"]
+
+
+def test_explain_localizes_delayed_work():
+    """Run b = run a + one no-op-shaped scheduling perturbation that fires a
+    visible fault-in later (crash+restart at 6s): every event BEFORE the
+    injection stays shared, pinning the alignment prefix."""
+    pa, pb = ProvenanceRecorder(), ProvenanceRecorder()
+    run_burn(8, provenance=pa, **QUIET)
+
+    def perturb(cluster):
+        cluster.queue.add_after(6_000_000, lambda: cluster.crash(3))
+        cluster.queue.add_after(8_000_000, lambda: cluster.restart(3))
+
+    run_burn(8, provenance=pb, perturb=perturb, **QUIET)
+    rep = explain_divergence(pa, pb)
+    assert rep is not None
+    assert rep["event_b"]["kind"] == K_CRASH
+    assert rep["event_b"]["sim_us"] == 6_000_000
+    # everything in the cone before the divergence index is marked shared —
+    # the causal run-up both runs agreed on
+    for d in rep["cone"]:
+        if d["pid"] < rep["index"]:
+            assert d["shared"]
+
+
+# ---------------------------------------------------------------------------
+# violation slicing
+# ---------------------------------------------------------------------------
+
+def test_strict_violation_carries_causal_slice():
+    prov = ProvenanceRecorder()
+    auditor = InvariantAuditor(mode="strict", provenance=prov)
+    t = tid(100)
+    auditor.on_transition(1, 0, t, "STABLE", 10)
+    auditor.on_transition(1, 0, t, "READY_TO_EXECUTE", 20)
+    with pytest.raises(AuditViolation) as exc:
+        auditor.on_transition(1, 0, t, "PRE_ACCEPTED", 30)
+    v = exc.value
+    assert v.rule == rules.RULE_ILLEGAL_EDGE
+    sl = v.causal_slice
+    assert sl is not None
+    # the anchor is the transition that tripped the rule (recorded BEFORE
+    # the rule check ran), and the report embeds the slice
+    anchor = [d for d in sl["events"] if d["pid"] == sl["anchor_pid"]]
+    assert len(anchor) == 1
+    assert anchor[0]["kind"] == K_TRANSITION
+    assert "PRE_ACCEPTED" in anchor[0]["what"]
+    assert v.report()["causal_slice"] == sl
+    rendered = render_slice(sl)
+    assert "causal slice" in rendered and "PRE_ACCEPTED" in rendered
+    # without provenance the slice is absent, not empty
+    bare = InvariantAuditor(mode="warn")
+    bare.on_transition(1, 0, t, "APPLIED", 10)
+    bare.on_transition(1, 0, t, "PRE_ACCEPTED", 20)
+    assert bare.violations[0].causal_slice is None
+    assert "causal_slice" not in bare.violations[0].report()
+
+
+def test_slice_for_anchors_and_fallbacks():
+    prov = ProvenanceRecorder()
+    t = tid(7)
+    prov.on_message_event("SEND", 1, 2, 5, None, 100)
+    prov.on_transition(2, 0, t, "PRE_ACCEPTED", 200)
+    prov.on_transition(2, 0, t, "STABLE", 300)
+    prov.on_transition(3, 0, t, "PRE_ACCEPTED", 400)
+    # exact (node, store) anchor: the txn's LATEST transition there
+    sl = prov.slice_for(txn_id=t, node=2, store=0)
+    assert prov.events[sl["anchor_pid"]][E_US] == 300
+    # unknown store falls back to the latest transition anywhere
+    sl2 = prov.slice_for(txn_id=t, node=9, store=9)
+    assert prov.events[sl2["anchor_pid"]][E_US] == 400
+    # no txn at all: the latest event of any kind
+    sl3 = prov.slice_for()
+    assert sl3["anchor_pid"] == len(prov.events) - 1
+    # unknown txn: no anchor, no slice
+    assert prov.slice_for(txn_id=tid(999)) is None
+    # empty recorder
+    assert ProvenanceRecorder().slice_for() is None
+
+
+def test_ancestor_cone_bounded_and_chained():
+    """A RECV claimed by an immediately-following handler chains handler ->
+    delivery -> send; an interleaved event breaks the claim."""
+    prov = ProvenanceRecorder()
+    prov.on_message_event("SEND", 1, 2, 5, None, 100)
+    prov.on_message_event("RECV", 1, 2, 5, None, 150)
+    prov.begin_handler(2, "PreAccept", tid(1), 150)
+    prov.on_transition(2, 0, tid(1), "PRE_ACCEPTED", 150)
+    prov.end()
+    send, recv, handler, transition = prov.events
+    assert handler[E_P2] == recv[E_PID]       # handler <- its delivery
+    assert recv[E_P2] == send[E_PID]          # delivery <- its send
+    assert transition[E_P1] == handler[E_PID]  # transition <- its handler
+    assert prov.ancestors(transition[E_PID]) == [0, 1, 2, 3]
+    assert prov.ancestors(transition[E_PID], hops=1) == [2, 3]
+    # an interleaved event clears the pending-recv claim
+    prov.on_message_event("RECV", 2, 3, 6, None, 200)
+    prov.on_message_event("DROP", 2, 4, 7, None, 210)
+    prov.begin_handler(3, "Accept", None, 220)
+    assert prov.events[-1][E_P2] is None
+    prov.end()
+
+
+def test_history_checker_attaches_causal_slices():
+    """check_history(provenance=...) decorates anomaly reports: each
+    implicated op with a known txn gains a causal slice (and the text
+    report says so)."""
+    from cassandra_accord_tpu.observe.checker import (HistoryAnomaly,
+                                                      check_history,
+                                                      format_report)
+    from cassandra_accord_tpu.observe.history import HistoryRecorder
+    prov = ProvenanceRecorder()
+    prov.on_transition(1, 0, "t1", "APPLIED", 100)
+    # lost update: an acked write whose value never made the final order
+    rec = HistoryRecorder()
+    rec.invoke(1, "t1", 0, (), {"k": "a"})
+    rec.resolve(1, "ok", 100, writes={"k": "a"})
+    with pytest.raises(HistoryAnomaly) as exc:
+        check_history(rec.ops, final_state={"k": ("b",)}, provenance=prov)
+    report = exc.value.report
+    a = report["anomalies"][0]
+    assert a["name"] == "lost-update"
+    assert "t1" in a["causal_slices"]
+    sl = a["causal_slices"]["t1"]
+    assert any("APPLIED" in d["what"] for d in sl["events"])
+    assert "causal slices attached" in format_report(report)
+
+
+# ---------------------------------------------------------------------------
+# exports: causal flow arrows + watchdog dump section
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_causal_flows_valid():
+    """--provenance + --trace-out: causal flow arrows ride the Perfetto
+    export and the artifact stays schema-valid (every flow id has a start,
+    every finish pairs with one)."""
+    prov = ProvenanceRecorder()
+    rec = FlightRecorder(record_messages=True, provenance=prov)
+    run_burn(13, observer=rec, **HOSTILE)
+    doc = rec.chrome_trace()
+    assert validate_chrome_trace(doc) == []
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "causal"]
+    assert flows, "no causal flow events exported"
+    by_id: dict = {}
+    for e in flows:
+        by_id.setdefault(e["id"], []).append(e["ph"])
+    for fid, phases in by_id.items():
+        assert phases[0] == "s" and phases[-1] == "f", fid
+    finishes = [e for e in flows if e["ph"] == "f"]
+    assert all(e.get("bp") == "e" for e in finishes)
+
+
+def test_validator_rejects_unmatched_flow_finish():
+    """Satellite: the validator must flag an ``f`` with no matching ``s``
+    (it previously only checked starts/ids)."""
+    base = {"cat": "causal", "ts": 1, "pid": 0, "tid": 0, "name": "x"}
+    s = dict(base, ph="s", id="flow-1")
+    f = dict(base, ph="f", id="flow-1", bp="e")
+    orphan = dict(base, ph="f", id="flow-2", bp="e")
+    assert validate_chrome_trace({"traceEvents": [s, f]}) == []
+    problems = validate_chrome_trace({"traceEvents": [s, f, orphan]})
+    assert any("no matching start" in p for p in problems), problems
+
+
+def test_watchdog_dump_includes_provenance_section():
+    from cassandra_accord_tpu.harness.burn import last_cluster
+    from cassandra_accord_tpu.harness.watchdog import dump_wait_state
+    prov = ProvenanceRecorder()
+    rec = FlightRecorder(provenance=prov)
+    run_burn(11, ops=10, concurrency=4, observer=rec)
+    cluster = last_cluster()
+    assert cluster is not None
+    dump = dump_wait_state(cluster)
+    assert "provenance: " in dump
+    line = next(l for l in dump.splitlines()
+                if l.startswith("provenance: "))
+    doc = json.loads(line.split("provenance: ", 1)[1])
+    assert doc["tail"]["events_total"] == len(prov.events)
+    assert "stall_root_slices" in doc
